@@ -1,0 +1,69 @@
+import pytest
+
+from turboprune_tpu.config import ConfigError, compose, compose_dict
+
+
+def test_compose_cifar10_imp():
+    cfg = compose("cifar10_imp")
+    assert cfg.dataset_params.dataset_name == "CIFAR10"
+    assert cfg.dataset_params.num_classes == 10
+    assert cfg.dataset_params.image_size == 32
+    assert cfg.pruning_params.prune_method == "mag"
+    assert cfg.pruning_params.training_type == "imp"
+    assert cfg.optimizer_params.lr == 0.2
+    assert cfg.optimizer_params.weight_decay == 5e-4
+    assert cfg.experiment_params.epochs_per_level == 150
+    assert cfg.cyclic_training.num_cycles == 1
+
+
+def test_compose_all_toplevel_configs():
+    from turboprune_tpu.config import DEFAULT_CONFIG_PATH
+
+    names = [p.stem for p in DEFAULT_CONFIG_PATH.glob("*.yaml")]
+    assert len(names) >= 12
+    for name in names:
+        cfg = compose(name)
+        cfg.validate()
+
+
+def test_overrides():
+    cfg = compose(
+        "cifar10_imp",
+        overrides=[
+            "optimizer_params.lr=0.01",
+            "experiment_params.epochs_per_level=2",
+            "dataset_params.total_batch_size=64",
+        ],
+    )
+    assert cfg.optimizer_params.lr == 0.01
+    assert cfg.experiment_params.epochs_per_level == 2
+    assert cfg.dataset_params.total_batch_size == 64
+
+
+def test_unknown_key_rejected():
+    with pytest.raises(ConfigError):
+        compose("cifar10_imp", overrides=["optimizer_params.typo_knob=1"])
+
+
+def test_bad_choice_rejected():
+    with pytest.raises(ConfigError):
+        compose("cifar10_imp", overrides=["pruning_params.prune_method=bogus"])
+
+
+def test_wr_requires_rewind_epoch():
+    with pytest.raises(ConfigError):
+        compose(
+            "cifar10_imp",
+            overrides=[
+                "pruning_params.training_type=wr",
+                "pruning_params.rewind_epoch=null",
+            ],
+        )
+
+
+def test_imagenet_defaults():
+    d = compose_dict("imagenet_imp")
+    assert d["experiment_params"]["distributed"] is True
+    cfg = compose("imagenet_imp")
+    assert cfg.dataset_params.num_classes == 1000
+    assert cfg.dataset_params.image_size == 224

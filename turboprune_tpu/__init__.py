@@ -1,0 +1,23 @@
+"""TurboPrune-TPU: a TPU-native lottery-ticket / pruning training framework.
+
+A ground-up JAX/XLA re-design of the capabilities of TurboPrune
+(nelaturuharsha/TurboPrune): iterative magnitude pruning (IMP with weight /
+learning-rate rewinding), pruning-at-initialization (SNIP, SynFlow, ER-ERK,
+ER-balanced), random ERK/balanced iterative pruning, and cyclic training
+schedules for ResNet / VGG / ViT(DeiT) on CIFAR-10/100 and ImageNet.
+
+Design (vs. the reference's PyTorch DDP + FFCV stack):
+  - masks are pytrees mirroring the prunable params, applied as ``w * m``
+    inside the jit-compiled forward (reference: mask buffers in custom
+    ``nn.Module`` subclasses, utils/mask_layers.py)
+  - pruning criteria are pure functions ``(params, masks, ...) -> masks``
+    (reference: in-place module walks, utils/pruning_utils.py)
+  - data parallelism is SPMD via ``jax.sharding`` over a device mesh with XLA
+    collectives on ICI/DCN (reference: DDP + NCCL, utils/distributed_utils.py)
+  - the input pipeline is device-resident CIFAR + a grain/tf.data ImageNet
+    loader (reference: airbench GPU loader + FFCV, utils/dataset.py)
+  - checkpoints are Orbax pytrees with the same artifact roles
+    (init / rewind / level_k) (reference: torch.save, utils/harness_utils.py)
+"""
+
+__version__ = "0.1.0"

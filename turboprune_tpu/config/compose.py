@@ -1,0 +1,102 @@
+"""Hydra-style config composition without the hydra dependency.
+
+The reference drives experiments with ``@hydra.main(config_path="conf")``
+composing six config groups (/root/reference/run_experiment.py:21,
+conf/cifar10_er_erk.yaml:1-8). This module reimplements the subset actually
+used — a top-level yaml with a ``defaults`` list of ``group: option`` entries,
+group files under ``conf/<group>/<option>.yaml``, and dotted CLI overrides
+``group.key=value`` — as ~100 lines of stdlib+pyyaml, then validates the
+result against the typed schema (which the reference never did).
+"""
+
+from __future__ import annotations
+
+import copy
+from pathlib import Path
+from typing import Optional, Sequence
+
+import yaml
+
+from .schema import ConfigError, MainConfig, config_from_dict
+
+DEFAULT_CONFIG_PATH = Path(__file__).resolve().parents[2] / "conf"
+
+
+def _load_yaml(path: Path) -> dict:
+    if not path.exists():
+        raise ConfigError(f"config file not found: {path}")
+    with open(path) as f:
+        data = yaml.safe_load(f) or {}
+    if not isinstance(data, dict):
+        raise ConfigError(f"config file {path} must contain a mapping")
+    return data
+
+
+def _deep_merge(base: dict, override: dict) -> dict:
+    out = copy.deepcopy(base)
+    for k, v in override.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = copy.deepcopy(v)
+    return out
+
+
+def _parse_override(item: str) -> tuple[list[str], object]:
+    if "=" not in item:
+        raise ConfigError(f"override {item!r} must look like group.key=value")
+    key, _, raw = item.partition("=")
+    value = yaml.safe_load(raw) if raw != "" else ""
+    return key.strip().split("."), value
+
+
+def _set_dotted(tree: dict, keys: list[str], value) -> None:
+    node = tree
+    for k in keys[:-1]:
+        node = node.setdefault(k, {})
+        if not isinstance(node, dict):
+            raise ConfigError(f"cannot override through non-mapping key {k!r}")
+    node[keys[-1]] = value
+
+
+def compose_dict(
+    config_name: str,
+    overrides: Sequence[str] = (),
+    config_path: Optional[Path] = None,
+) -> dict:
+    """Compose the raw config dict (pre-validation)."""
+    root = Path(config_path) if config_path else DEFAULT_CONFIG_PATH
+    name = config_name[:-5] if config_name.endswith(".yaml") else config_name
+    top = _load_yaml(root / f"{name}.yaml")
+    defaults = top.pop("defaults", [])
+
+    merged: dict = {}
+    self_merged = False
+    for entry in defaults:
+        if entry == "_self_":
+            merged = _deep_merge(merged, top)
+            self_merged = True
+            continue
+        if not isinstance(entry, dict) or len(entry) != 1:
+            raise ConfigError(f"defaults entry {entry!r} must be 'group: option'")
+        (group, option), = entry.items()
+        if option is None:
+            continue
+        group_cfg = _load_yaml(root / group / f"{option}.yaml")
+        merged = _deep_merge(merged, {group: group_cfg})
+    if not self_merged:
+        merged = _deep_merge(merged, top)
+
+    for item in overrides:
+        keys, value = _parse_override(item)
+        _set_dotted(merged, keys, value)
+    return merged
+
+
+def compose(
+    config_name: str,
+    overrides: Sequence[str] = (),
+    config_path: Optional[Path] = None,
+) -> MainConfig:
+    """Compose and validate a full MainConfig."""
+    return config_from_dict(compose_dict(config_name, overrides, config_path))

@@ -1,0 +1,77 @@
+"""Model registry.
+
+Replaces the reference's two-path factory (torchvision lookup with CIFAR
+surgery + broken CustomModel globals() lookup,
+/root/reference/utils/custom_models.py:169-245,
+standard_pruning_harness.py:128-143) with a single explicit registry; CIFAR
+stem surgery is a constructor argument instead of post-hoc module patching.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax.numpy as jnp
+
+from . import resnet, vgg, vit
+from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152
+from .vgg import VGG
+from .vit import VisionTransformer
+
+MODEL_REGISTRY: dict[str, Callable] = {
+    "resnet18": resnet.resnet18,
+    "resnet34": resnet.resnet34,
+    "resnet50": resnet.resnet50,
+    "resnet101": resnet.resnet101,
+    "resnet152": resnet.resnet152,
+    "vgg11": vgg.vgg11,
+    "vgg11_bn": vgg.vgg11_bn,
+    "vgg13": vgg.vgg13,
+    "vgg13_bn": vgg.vgg13_bn,
+    "vgg16": vgg.vgg16,
+    "vgg16_bn": vgg.vgg16_bn,
+    "vgg19": vgg.vgg19,
+    "vgg19_bn": vgg.vgg19_bn,
+    "deit_tiny_patch16_224": vit.deit_tiny_patch16_224,
+    "deit_small_patch16_224": vit.deit_small_patch16_224,
+    "deit_base_patch16_224": vit.deit_base_patch16_224,
+    "deit_base_patch16_384": vit.deit_base_patch16_384,
+    "deit_tiny_distilled_patch16_224": vit.deit_tiny_distilled_patch16_224,
+    "deit_small_distilled_patch16_224": vit.deit_small_distilled_patch16_224,
+    "deit_base_distilled_patch16_224": vit.deit_base_distilled_patch16_224,
+    "deit_base_distilled_patch16_384": vit.deit_base_distilled_patch16_384,
+}
+
+
+def create_model(
+    model_name: str,
+    num_classes: int,
+    dataset_name: str = "CIFAR10",
+    compute_dtype: Any = jnp.float32,
+):
+    """Build a model module with dataset-appropriate stem.
+
+    CIFAR datasets get the reference's stem surgery
+    (custom_models.py:197-215) via ``cifar_stem=True``."""
+    if model_name not in MODEL_REGISTRY:
+        raise ValueError(
+            f"Model {model_name!r} not in registry: {sorted(MODEL_REGISTRY)}"
+        )
+    cifar_stem = dataset_name.lower() in ("cifar10", "cifar100")
+    return MODEL_REGISTRY[model_name](
+        num_classes, cifar_stem=cifar_stem, dtype=compute_dtype
+    )
+
+
+__all__ = [
+    "MODEL_REGISTRY",
+    "create_model",
+    "ResNet",
+    "VGG",
+    "VisionTransformer",
+    "resnet18",
+    "resnet34",
+    "resnet50",
+    "resnet101",
+    "resnet152",
+]

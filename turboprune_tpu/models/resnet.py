@@ -1,0 +1,151 @@
+"""ResNet family (torchvision-compatible topology) in Flax linen, NHWC.
+
+Rebuilds the architectures the reference gets from
+``torchvision.models.resnet*`` (/root/reference/utils/custom_models.py:184)
+with the same CIFAR stem surgery: 3x3 stride-1 conv1, no maxpool, fresh fc
+(custom_models.py:197-215). NHWC layout and bf16-friendly compute for the
+TPU MXU; BatchNorm statistics are batch-local by default (the reference uses
+unsynced per-replica BN under DDP, SURVEY.md §7 hard parts — pass
+``bn_cross_replica_axis`` to opt into sync-BN under shard_map).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+    expansion: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.ones)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * self.expansion,
+                (1, 1),
+                strides=(self.strides, self.strides),
+                name="downsample_conv",
+            )(residual)
+            residual = self.norm(name="downsample_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class Bottleneck(nn.Module):
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        # torchvision puts the stride on the 3x3 conv (ResNet v1.5)
+        y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides))(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * self.expansion, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.ones)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * self.expansion,
+                (1, 1),
+                strides=(self.strides, self.strides),
+                name="downsample_conv",
+            )(residual)
+            residual = self.norm(name="downsample_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block_cls: type
+    num_classes: int
+    cifar_stem: bool = False
+    width: int = 64
+    dtype: Any = jnp.float32
+    bn_momentum: float = 0.9  # = 1 - torch BatchNorm momentum 0.1
+    bn_epsilon: float = 1e-5
+    bn_cross_replica_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(
+            nn.Conv,
+            use_bias=False,
+            dtype=self.dtype,
+            kernel_init=nn.initializers.variance_scaling(2.0, "fan_out", "normal"),
+        )
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=self.bn_momentum,
+            epsilon=self.bn_epsilon,
+            dtype=self.dtype,
+            axis_name=self.bn_cross_replica_axis,
+        )
+        x = x.astype(self.dtype)
+        if self.cifar_stem:
+            # CIFAR surgery: 3x3 stride-1 conv, no maxpool
+            # (reference custom_models.py:200-206)
+            x = conv(self.width, (3, 3), name="conv1")(x)
+            x = norm(name="bn1")(x)
+            x = nn.relu(x)
+        else:
+            x = conv(self.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)], name="conv1")(x)
+            x = norm(name="bn1")(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block_cls(
+                    filters=self.width * 2**i,
+                    strides=strides,
+                    conv=conv,
+                    norm=norm,
+                    name=f"layer{i + 1}_{j}",
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = x.astype(jnp.float32)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(x)
+        return x
+
+
+def resnet18(num_classes: int, cifar_stem: bool = False, **kw) -> ResNet:
+    return ResNet([2, 2, 2, 2], BasicBlock, num_classes, cifar_stem, **kw)
+
+
+def resnet34(num_classes: int, cifar_stem: bool = False, **kw) -> ResNet:
+    return ResNet([3, 4, 6, 3], BasicBlock, num_classes, cifar_stem, **kw)
+
+
+def resnet50(num_classes: int, cifar_stem: bool = False, **kw) -> ResNet:
+    return ResNet([3, 4, 6, 3], Bottleneck, num_classes, cifar_stem, **kw)
+
+
+def resnet101(num_classes: int, cifar_stem: bool = False, **kw) -> ResNet:
+    return ResNet([3, 4, 23, 3], Bottleneck, num_classes, cifar_stem, **kw)
+
+
+def resnet152(num_classes: int, cifar_stem: bool = False, **kw) -> ResNet:
+    return ResNet([3, 8, 36, 3], Bottleneck, num_classes, cifar_stem, **kw)

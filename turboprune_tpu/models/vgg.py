@@ -1,0 +1,111 @@
+"""VGG (torchvision-compatible topology, BN variants) in Flax linen, NHWC.
+
+The reference supports ``vgg*`` via torchvision with CIFAR surgery replacing
+the first conv and the classifier's final Linear
+(/root/reference/utils/custom_models.py:207-215). torchvision's VGG runs an
+AdaptiveAvgPool2d((7,7)) between features and classifier; we reproduce its
+semantics (identity at 224 input, replication upsample from 1x1 at CIFAR
+sizes) with a static-shape adaptive pool so both input sizes jit cleanly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# torchvision cfgs: D = vgg16, E = vgg19 ("M" = maxpool)
+VGG_CFGS = {
+    "vgg11": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "vgg13": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "vgg16": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+              512, 512, 512, "M", 512, 512, 512, "M"],
+    "vgg19": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+              512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+def adaptive_avg_pool(x: jnp.ndarray, out_hw: int = 7) -> jnp.ndarray:
+    """torch AdaptiveAvgPool2d semantics for static NHWC shapes."""
+    n, h, w, c = x.shape
+    if h == out_hw and w == out_hw:
+        return x
+    if h == 1 and w == 1:
+        return jnp.broadcast_to(x, (n, out_hw, out_hw, c))
+    # bin i covers [floor(i*H/out), ceil((i+1)*H/out)) — computed statically
+    def pool_axis(arr, size, axis):
+        pieces = []
+        for i in range(out_hw):
+            start = (i * size) // out_hw
+            end = -(-((i + 1) * size) // out_hw)
+            sl = [slice(None)] * arr.ndim
+            sl[axis] = slice(start, end)
+            pieces.append(arr[tuple(sl)].mean(axis=axis, keepdims=True))
+        return jnp.concatenate(pieces, axis=axis)
+
+    return pool_axis(pool_axis(x, h, 1), w, 2)
+
+
+class VGG(nn.Module):
+    cfg: Sequence
+    num_classes: int
+    batch_norm: bool = True
+    cifar_head: bool = False
+    dtype: Any = jnp.float32
+    dropout_rate: float = 0.5
+    bn_momentum: float = 0.9
+    bn_epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        conv_idx = 0
+        for v in self.cfg:
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(
+                    v, (3, 3), padding=[(1, 1), (1, 1)], use_bias=True,
+                    dtype=self.dtype, name=f"conv{conv_idx}",
+                )(x)
+                if self.batch_norm:
+                    x = nn.BatchNorm(
+                        use_running_average=not train,
+                        momentum=self.bn_momentum,
+                        epsilon=self.bn_epsilon,
+                        dtype=self.dtype,
+                        name=f"bn{conv_idx}",
+                    )(x)
+                x = nn.relu(x)
+                conv_idx += 1
+        x = adaptive_avg_pool(x, 7)
+        x = x.reshape((x.shape[0], -1)).astype(jnp.float32)
+        x = nn.Dense(4096, dtype=jnp.float32, name="fc0")(x)
+        x = nn.relu(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(4096, dtype=jnp.float32, name="fc1")(x)
+        x = nn.relu(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="fc2")(x)
+        return x
+
+
+def _make(name: str, batch_norm: bool):
+    def ctor(num_classes: int, cifar_stem: bool = False, **kw) -> VGG:
+        return VGG(
+            VGG_CFGS[name], num_classes, batch_norm=batch_norm,
+            cifar_head=cifar_stem, **kw,
+        )
+
+    return ctor
+
+
+vgg11 = _make("vgg11", False)
+vgg11_bn = _make("vgg11", True)
+vgg13 = _make("vgg13", False)
+vgg13_bn = _make("vgg13", True)
+vgg16 = _make("vgg16", False)
+vgg16_bn = _make("vgg16", True)
+vgg19 = _make("vgg19", False)
+vgg19_bn = _make("vgg19", True)

@@ -1,0 +1,194 @@
+"""Mask pytrees — the sparsity mechanism.
+
+The reference stores masks as buffers on custom module subclasses and
+multiplies ``mask * weight`` in every forward
+(/root/reference/utils/mask_layers.py:25,69,109). Here masks are a pytree
+mirroring the model params, with a boolean array at every *prunable* leaf
+(conv / dense kernels — reference masks every Conv2d and Linear, including
+the classifier head, custom_models.py:217-220) and ``None`` elsewhere.
+``apply_masks`` multiplies them into the params inside the jitted forward, so
+XLA fuses the multiply into the convolution's operand producer; gradients
+flow to the raw params scaled by the mask exactly as in the reference
+(pruned weights get zero gradient from the forward but can still drift via
+momentum / weight decay — a semantic we preserve, SURVEY.md §3.3).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+# Treat None as a leaf so mask trees (None at non-prunable positions) keep the
+# exact structure of the param tree.
+def _is_none(x) -> bool:
+    return x is None
+
+
+def is_prunable_path(path: tuple) -> bool:
+    """A param leaf is prunable iff it is a conv/dense kernel.
+
+    Flax linen names conv and dense weights 'kernel'; biases are 'bias' and
+    norm params 'scale'/'bias' — matching the reference's rule of masking
+    exactly the Conv2d/Linear weights (custom_models.py:217-220)."""
+    last = path[-1]
+    key = getattr(last, "key", getattr(last, "name", str(last)))
+    return str(key) == "kernel"
+
+
+def tree_paths(tree: PyTree) -> Iterator[tuple]:
+    for path, _ in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        yield path
+
+
+def path_name(path: tuple) -> str:
+    parts = []
+    for p in path:
+        parts.append(str(getattr(p, "key", getattr(p, "name", getattr(p, "idx", p)))))
+    return "/".join(parts)
+
+
+def make_masks(
+    params: PyTree, predicate: Callable[[tuple], bool] = is_prunable_path
+) -> PyTree:
+    """Dense (all-ones) mask tree: bool ones at prunable leaves, None elsewhere."""
+
+    def leaf_mask(path, leaf):
+        if predicate(path):
+            return jnp.ones(jnp.shape(leaf), dtype=jnp.bool_)
+        return None
+
+    return jax.tree_util.tree_map_with_path(leaf_mask, params)
+
+
+def apply_masks(params: PyTree, masks: PyTree) -> PyTree:
+    """``w * m`` at masked leaves; identity elsewhere. Call inside jit."""
+
+    def apply(m, p):
+        if m is None:
+            return p
+        return p * m.astype(p.dtype)
+
+    return jax.tree.map(apply, masks, params, is_leaf=_is_none)
+
+
+def mask_where(masks: PyTree, fn: Callable[..., jax.Array], *trees: PyTree) -> PyTree:
+    """Map ``fn(mask, *leaves)`` over masked positions only; None passthrough."""
+
+    def go(m, *leaves):
+        if m is None:
+            return None
+        return fn(m, *leaves)
+
+    return jax.tree.map(go, masks, *trees, is_leaf=_is_none)
+
+
+def mask_leaves(masks: PyTree) -> list[jax.Array]:
+    return [m for m in jax.tree.leaves(masks, is_leaf=_is_none) if m is not None]
+
+
+def mask_leaves_with_path(masks: PyTree) -> list[tuple[tuple, jax.Array]]:
+    out = []
+    for path, m in jax.tree_util.tree_flatten_with_path(
+        masks, is_leaf=_is_none
+    )[0]:
+        if m is not None:
+            out.append((path, m))
+    return out
+
+
+def num_prunable(masks: PyTree) -> int:
+    return sum(int(m.size) for m in mask_leaves(masks))
+
+
+def overall_sparsity(masks: PyTree) -> float:
+    """Percent of prunable weights masked out (reference
+    PruneModel.get_overall_sparsity, custom_models.py:51-62 — returns %)."""
+    total = 0
+    zeros = 0
+    for m in mask_leaves(masks):
+        total += int(m.size)
+        zeros += int(m.size - jnp.sum(m))
+    return (zeros / total) * 100.0 if total else 0.0
+
+
+def overall_density(masks: PyTree) -> float:
+    return 1.0 - overall_sparsity(masks) / 100.0
+
+
+def layerwise_sparsity(masks: PyTree) -> dict[str, float]:
+    """Per-layer sparsity %, keyed by param path (reference
+    print_layer_sparsity, custom_models.py:29-49)."""
+    out = {}
+    for path, m in mask_leaves_with_path(masks):
+        zeros = int(m.size - jnp.sum(m))
+        out[path_name(path)] = (zeros / m.size) * 100.0
+    return out
+
+
+def reset_masks(masks: PyTree) -> PyTree:
+    """All-ones masks of the same structure (reference reset_masks,
+    custom_models.py:148-151)."""
+    return mask_where(masks, lambda m: jnp.ones_like(m))
+
+
+def combine_rewind(
+    current_params: PyTree, rewind_params: PyTree, masks: PyTree
+) -> PyTree:
+    """Weight rewinding: restore ALL params from the rewind checkpoint.
+
+    The reference restores every non-mask tensor (custom_models.py:137-144);
+    masks live in a separate tree here, so this is a full param swap — kept as
+    a named op so the call site documents intent."""
+    del current_params, masks
+    return rewind_params
+
+
+def global_threshold_mask(
+    scores: PyTree, masks: PyTree, density: float
+) -> PyTree:
+    """Global magnitude-style masking: keep weights whose score exceeds the
+    k-th smallest score, k = (1-density) * N over ALL prunable weights
+    (reference prune_mag, pruning_utils.py:61-89: global kthvalue then
+    ``mask = score > threshold``).
+
+    Scores at already-pruned positions must be 0 (callers multiply by the
+    mask) so pruning is monotone across levels."""
+    flat = jnp.concatenate(
+        [s.reshape(-1) for s in mask_leaves(scores)]
+    ).astype(jnp.float32)
+    n = flat.shape[0]
+    k = jnp.int32(jnp.floor((1.0 - density) * n))
+    sorted_scores = jnp.sort(flat)
+    # kthvalue(k) with k>=1 → sorted[k-1]; k==0 → threshold below min (keep all)
+    threshold = jnp.where(k > 0, sorted_scores[jnp.maximum(k - 1, 0)], -jnp.inf)
+    return mask_where(scores, lambda s: s > threshold)
+
+
+def per_layer_threshold_mask(scores: PyTree, densities: dict[str, float]) -> PyTree:
+    """Per-layer kthvalue masking used by random_erk / random_balanced
+    (reference pruning_utils.py:126-146, 326-347)."""
+
+    def one(path, s):
+        d = densities[path_name(path)]
+        n = s.size
+        k = int((1.0 - d) * n)
+        if k <= 0:
+            return jnp.ones_like(s, dtype=jnp.bool_)
+        flat = jnp.sort(s.reshape(-1).astype(jnp.float32))
+        threshold = flat[k - 1]
+        return s > threshold
+
+    return _map_with_path_masked(one, scores)
+
+
+def _map_with_path_masked(fn, masks_like: PyTree) -> PyTree:
+    def go(path, m):
+        if m is None:
+            return None
+        return fn(path, m)
+
+    return jax.tree_util.tree_map_with_path(go, masks_like, is_leaf=_is_none)
