@@ -156,15 +156,18 @@ def global_threshold_mask(
     ``mask = score > threshold``).
 
     Scores at already-pruned positions must be 0 (callers multiply by the
-    mask) so pruning is monotone across levels."""
+    mask) so pruning is monotone across levels. When k < 1 the reference
+    leaves the masks untouched (pruning_utils.py:81) — replicated here; the
+    density is a host-side float so k is static."""
     flat = jnp.concatenate(
         [s.reshape(-1) for s in mask_leaves(scores)]
     ).astype(jnp.float32)
     n = flat.shape[0]
-    k = jnp.int32(jnp.floor((1.0 - density) * n))
+    k = int((1.0 - density) * n)
+    if k < 1:
+        return masks
     sorted_scores = jnp.sort(flat)
-    # kthvalue(k) with k>=1 → sorted[k-1]; k==0 → threshold below min (keep all)
-    threshold = jnp.where(k > 0, sorted_scores[jnp.maximum(k - 1, 0)], -jnp.inf)
+    threshold = sorted_scores[k - 1]  # kthvalue(k), 1-indexed
     return mask_where(scores, lambda s: s > threshold)
 
 

@@ -1,0 +1,134 @@
+"""Pruning engine: dispatcher + criteria + density ladders.
+
+Replaces the reference's ``prune_the_model`` globals() dispatch
+(/root/reference/utils/pruning_utils.py:23-58) with an explicit registry of
+pure functions. Pruning runs replicated on every host from replicated state
+(same inputs + same PRNG key → identical masks), which supersedes the
+reference's rank-0-prune-then-DDP-broadcast protocol (SURVEY.md §3.1).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.masking import PyTree, apply_masks
+from . import criteria, densities
+from .criteria import (
+    balanced_densities,
+    erk_densities,
+    prune_er_balanced,
+    prune_er_erk,
+    prune_mag,
+    prune_random_balanced,
+    prune_random_erk,
+    prune_snip,
+    prune_synflow,
+)
+from .densities import generate_cyclical_schedule, generate_densities
+
+DATA_FREE_METHODS = ("mag", "random_erk", "random_balanced", "er_erk", "er_balanced")
+DATA_DRIVEN_METHODS = ("snip", "synflow")
+
+
+def softmax_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    return -jnp.take_along_axis(logp, labels[:, None], axis=1).mean()
+
+
+def prune_the_model(
+    method: str,
+    model,
+    variables: PyTree,
+    masks: PyTree,
+    density: float,
+    rng: jax.Array,
+    batch: Optional[tuple] = None,
+) -> PyTree:
+    """Dispatch a pruning criterion; returns the new mask pytree.
+
+    ``batch`` (images, labels) is required for snip (real data) and synflow
+    (shape/dtype only — it forwards an all-ones input, reference
+    pruning_utils.py:256-257)."""
+    params = variables["params"]
+
+    if method == "just dont":
+        return masks
+    if method == "mag":
+        return prune_mag(params, masks, density)
+    if method == "random_erk":
+        return prune_random_erk(params, masks, density, rng)
+    if method == "random_balanced":
+        return prune_random_balanced(params, masks, density, rng)
+    if method == "er_erk":
+        return prune_er_erk(params, masks, density, rng)
+    if method == "er_balanced":
+        return prune_er_balanced(params, masks, density, rng)
+
+    if method in DATA_DRIVEN_METHODS and batch is None:
+        raise ValueError(f"{method} pruning requires a data batch")
+
+    extra_vars = {k: v for k, v in variables.items() if k != "params"}
+
+    if method == "snip":
+
+        def loss_grad_fn(p, m, b):
+            images, labels = b
+
+            def loss(p_):
+                out = model.apply(
+                    {"params": apply_masks(p_, m), **extra_vars},
+                    images,
+                    train=True,
+                    mutable=list(extra_vars.keys()),
+                    rngs={"dropout": rng},
+                )
+                logits = out[0] if isinstance(out, tuple) else out
+                return softmax_cross_entropy(logits, labels)
+
+            return jax.grad(loss)(p)
+
+        return prune_snip(loss_grad_fn, params, masks, density, batch)
+
+    if method == "synflow":
+        images, _ = batch
+        ones_input = jnp.ones((1,) + images.shape[1:], images.dtype)
+        variables_abs = jax.tree.map(jnp.abs, variables)
+
+        def forward_sum_fn(p_abs, m, x):
+            out = model.apply(
+                {"params": apply_masks(p_abs, m), **extra_vars},
+                x,
+                train=True,
+                mutable=list(extra_vars.keys()),
+                rngs={"dropout": rng},
+            )
+            logits = out[0] if isinstance(out, tuple) else out
+            return jnp.sum(logits)
+
+        return prune_synflow(
+            forward_sum_fn, variables_abs, params, masks, density, ones_input
+        )
+
+    raise ValueError(f"Unknown pruning method: {method}")
+
+
+__all__ = [
+    "prune_the_model",
+    "prune_mag",
+    "prune_snip",
+    "prune_synflow",
+    "prune_random_erk",
+    "prune_random_balanced",
+    "prune_er_erk",
+    "prune_er_balanced",
+    "erk_densities",
+    "balanced_densities",
+    "generate_densities",
+    "generate_cyclical_schedule",
+    "softmax_cross_entropy",
+    "criteria",
+    "densities",
+]

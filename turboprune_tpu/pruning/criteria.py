@@ -1,0 +1,221 @@
+"""Pruning criteria as pure functions ``(params, masks, ...) -> masks``.
+
+Rebuilds every criterion of the reference's pruning engine
+(/root/reference/utils/pruning_utils.py) as side-effect-free pytree ops:
+
+  mag              global |mask*w| kthvalue threshold   (pruning_utils.py:61-89)
+  snip             one-batch |grad*w*mask|, global      (:160-205)
+  synflow          abs-linearized ones-forward saliency (:208-285)
+  random_erk       ERK layer densities + random scores  (:92-146)
+  random_balanced  equal per-layer budget + random      (:288-347)
+  er_erk           ERK densities, Bernoulli masks (PaI) (:350-378)
+  er_balanced      balanced densities, Bernoulli (PaI)  (:381-415)
+
+All run replicated on every host from replicated state — determinism by
+construction replaces the reference's rank-0-prune + DDP-broadcast dance
+(SURVEY.md §3.1). The PRNG key is passed in explicitly so every host derives
+identical Bernoulli/normal draws.
+
+SynFlow's in-place abs/sign dance (pruning_utils.py:223-248) becomes a pure
+``tree_map(abs)`` — no sign restore needed since the real params are never
+touched.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.masking import (
+    PyTree,
+    global_threshold_mask,
+    mask_leaves,
+    mask_leaves_with_path,
+    mask_where,
+    path_name,
+    per_layer_threshold_mask,
+)
+
+# ---------------------------------------------------------------------------
+# helpers
+
+
+def _random_normal_scores(masks: PyTree, rng: jax.Array) -> PyTree:
+    """|N(0,1)| scores at unmasked positions, 0 at masked (so previously
+    pruned weights can never win a per-layer threshold)."""
+    leaves = mask_leaves(masks)
+    keys = jax.random.split(rng, len(leaves))
+    it = iter(range(len(leaves)))
+
+    def score(m):
+        k = keys[next(it)]
+        return m.astype(jnp.float32) * jnp.abs(
+            jax.random.normal(k, m.shape, jnp.float32)
+        )
+
+    return mask_where(masks, score)
+
+
+def _layer_sizes(masks: PyTree) -> list[tuple[str, tuple, int]]:
+    """[(path_name, shape, numel)] per prunable layer, in traversal order."""
+    out = []
+    for path, m in mask_leaves_with_path(masks):
+        out.append((path_name(path), tuple(m.shape), int(m.size)))
+    return out
+
+
+def erk_densities(masks: PyTree, density: float) -> dict[str, float]:
+    """ERK allocation: layer density ∝ sum(kernel shape)/numel, scaled by a
+    global factor C so the total kept-parameter budget hits ``density``, each
+    clamped to [0, 1] (reference pruning_utils.py:102-127, 357-371).
+
+    Note: the reference computes the fc layer's shape sum through its
+    Conv1dMask (out, in, 1) representation, adding a stray +1; we use the
+    true (in, out) Dense shape."""
+    layers = _layer_sizes(masks)
+    raw = [sum(shape) / numel for _, shape, numel in layers]
+    total = sum(numel for _, _, numel in layers)
+    kept = sum(r * numel for r, (_, _, numel) in zip(raw, layers))
+    c = density * total / kept
+    return {
+        name: float(min(max(c * r, 0.0), 1.0))
+        for r, (name, _, _) in zip(raw, layers)
+    }
+
+
+def balanced_densities(masks: PyTree, density: float) -> dict[str, float]:
+    """Balanced allocation: equal kept-parameter count X = density*total/L per
+    layer; layers smaller than X saturate at density 1 and their surplus is
+    redistributed (reference pruning_utils.py:298-327, 388-407, including its
+    L - i divisor)."""
+    layers = _layer_sizes(masks)
+    total = sum(numel for _, _, numel in layers)
+    L = len(layers)
+    X = density * total / L
+    out = {}
+    for i, (name, _, numel) in enumerate(layers):
+        if X / numel < 1.0:
+            out[name] = X / numel
+        else:
+            out[name] = 1.0
+            diff = X - numel
+            X = X + diff / (L - i)
+    return out
+
+
+def _bernoulli_masks(
+    masks: PyTree, densities: dict[str, float], rng: jax.Array
+) -> PyTree:
+    """set_er_mask: mask ~ Bernoulli(p) per layer (reference
+    mask_layers.py:36-43)."""
+    names = [name for name, _, _ in _layer_sizes(masks)]
+    keys = dict(zip(names, jax.random.split(rng, len(names))))
+
+    def go(path, m):
+        if m is None:
+            return None
+        name = path_name(path)
+        return jax.random.bernoulli(keys[name], densities[name], m.shape)
+
+    return jax.tree_util.tree_map_with_path(
+        go, masks, is_leaf=lambda x: x is None
+    )
+
+
+# ---------------------------------------------------------------------------
+# criteria
+
+
+def prune_mag(params: PyTree, masks: PyTree, density: float) -> PyTree:
+    scores = mask_where(
+        masks, lambda m, p: jnp.abs(p * m.astype(p.dtype)), params
+    )
+    return global_threshold_mask(scores, masks, density)
+
+
+def prune_random_erk(
+    params: PyTree, masks: PyTree, density: float, rng: jax.Array
+) -> PyTree:
+    del params
+    densities = erk_densities(masks, density)
+    scores = _random_normal_scores(masks, rng)
+    return per_layer_threshold_mask(scores, densities)
+
+
+def prune_random_balanced(
+    params: PyTree, masks: PyTree, density: float, rng: jax.Array
+) -> PyTree:
+    del params
+    densities = balanced_densities(masks, density)
+    scores = _random_normal_scores(masks, rng)
+    return per_layer_threshold_mask(scores, densities)
+
+
+def prune_er_erk(
+    params: PyTree, masks: PyTree, density: float, rng: jax.Array
+) -> PyTree:
+    del params
+    return _bernoulli_masks(masks, erk_densities(masks, density), rng)
+
+
+def prune_er_balanced(
+    params: PyTree, masks: PyTree, density: float, rng: jax.Array
+) -> PyTree:
+    del params
+    return _bernoulli_masks(masks, balanced_densities(masks, density), rng)
+
+
+def prune_snip(
+    loss_grad_fn: Callable[[PyTree, PyTree, Any], PyTree],
+    params: PyTree,
+    masks: PyTree,
+    density: float,
+    batch: Any,
+) -> PyTree:
+    """SNIP: saliency |∂L/∂w * w * m| on ONE batch, global threshold.
+
+    ``loss_grad_fn(params, masks, batch) -> grads`` must differentiate the
+    masked forward's CE loss wrt the raw params (so grads already carry the
+    mask factor, matching the reference's masked-layer backward,
+    pruning_utils.py:186-191)."""
+    grads = loss_grad_fn(params, masks, batch)
+    scores = mask_where(
+        masks,
+        lambda m, g, p: jnp.abs(g * p * m.astype(p.dtype)).astype(jnp.float32),
+        grads,
+        params,
+    )
+    return global_threshold_mask(scores, masks, density)
+
+
+def prune_synflow(
+    forward_sum_fn: Callable[[PyTree, PyTree, Any], jax.Array],
+    variables_abs: PyTree,
+    params: PyTree,
+    masks: PyTree,
+    density: float,
+    ones_input: jax.Array,
+) -> PyTree:
+    """SynFlow: R = sum(f_|θ|(1)); score |m * ∂R/∂w * w| on the ABS params.
+
+    The reference abs-es the whole state dict in place, backprops a ones
+    input, then restores signs (pruning_utils.py:223-271). Purely: the caller
+    passes ``variables_abs`` = tree_map(abs, variables); we differentiate
+    wrt its params and score with the ORIGINAL param magnitudes (|w| equals
+    abs(w), so scoring with either matches the reference)."""
+    del params
+
+    def loss(p_abs):
+        return forward_sum_fn(p_abs, masks, ones_input)
+
+    grads = jax.grad(loss)(variables_abs["params"])
+    scores = mask_where(
+        masks,
+        lambda m, g, p: (m.astype(jnp.float32)
+                         * jnp.abs(g.astype(jnp.float32) * p.astype(jnp.float32))),
+        grads,
+        variables_abs["params"],
+    )
+    return global_threshold_mask(scores, masks, density)
