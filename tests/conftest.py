@@ -8,7 +8,7 @@ replicated-prune determinism) without TPU hardware.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -17,7 +17,12 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402  (import after env setup)
 
-import pytest
+# The TPU-tunnel sitecustomize calls jax.config.update("jax_platforms",
+# "axon,cpu") at interpreter start, which outranks the env var — force the
+# config back to CPU so tests get the 8-device virtual mesh.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")

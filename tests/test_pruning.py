@@ -194,3 +194,32 @@ def test_dense_method_noop(tiny):
         "just dont", model, variables, masks, 1.0, jax.random.PRNGKey(0)
     )
     assert overall_sparsity(new) == 0.0
+
+
+def test_per_layer_saturated_density_keeps_pruned_weights():
+    """A layer whose allocated density clamps to 1.0 (k<=0) must keep its
+    existing mask, not resurrect pruned weights (reference k==0 threshold-0
+    semantics, pruning_utils.py:137-143)."""
+    from turboprune_tpu.ops.masking import per_layer_threshold_mask
+
+    prev_mask = jnp.array([[True, False], [True, True]])
+    scores = prev_mask.astype(jnp.float32) * jnp.array([[0.5, 0.9], [0.3, 0.7]])
+    tree = {"layer": {"kernel": scores}}
+    out = per_layer_threshold_mask(tree, {"layer/kernel": 1.0})
+    assert not bool(out["layer"]["kernel"][0, 1])  # stays pruned
+    assert bool(out["layer"]["kernel"].sum() == 3)
+
+
+def test_iterative_random_erk_monotone(tiny):
+    """random_erk is iterative (ITERATIVE_METHODS); masks must be monotone
+    across levels even when small layers saturate at density 1."""
+    model, variables, masks = tiny
+    ds = generate_densities("random_erk", 0.8, 0.5)
+    prev = masks
+    for d in ds[1:]:
+        new = prune_the_model(
+            "random_erk", model, variables, prev, d, jax.random.PRNGKey(0)
+        )
+        for old_m, new_m in zip(mask_leaves(prev), mask_leaves(new)):
+            assert int(jnp.logical_and(new_m, jnp.logical_not(old_m)).sum()) == 0
+        prev = new

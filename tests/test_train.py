@@ -1,0 +1,214 @@
+"""Train layer: schedules, optimizer parity vs torch, step semantics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from turboprune_tpu.models import create_model
+from turboprune_tpu.ops.masking import apply_masks, make_masks, mask_where
+from turboprune_tpu.train import (
+    TrainState,
+    create_optimizer,
+    create_schedule,
+    create_train_state,
+    make_eval_step,
+    make_train_step,
+    reset_optimizer,
+    sgd,
+    triangular_schedule,
+)
+
+
+# ---------------------------------------------------------------------------
+# schedules
+
+
+def test_triangular_shape():
+    lr = 0.2
+    sched = triangular_schedule(lr, total_steps=100, warmup_fraction=0.2)
+    assert np.isclose(float(sched(0)), 0.2 * lr)       # starts at 0.2x
+    assert np.isclose(float(sched(20)), lr)            # peak at warmup end
+    assert np.isclose(float(sched(100)), 0.0)          # decays to 0
+    # linear in both phases
+    assert np.isclose(float(sched(10)), lr * (0.2 + 0.8 * 0.5))
+    assert np.isclose(float(sched(60)), lr * 0.5)
+
+
+def test_trapezoidal_shape():
+    sched = create_schedule("TrapezoidalSchedule", 0.1, epochs=10, steps_per_epoch=10)
+    vals = [float(sched(s)) for s in range(101)]
+    assert vals[0] < vals[10] < vals[20]               # warming up
+    assert np.isclose(vals[50], 0.1)                   # plateau at base lr
+    assert vals[95] < vals[50]                         # cooling down
+
+
+def test_multistep_warmup_drops():
+    sched = create_schedule(
+        "ImageNetLRDropsWarmup", 0.4, epochs=90, steps_per_epoch=100
+    )
+    assert float(sched(5 * 100)) < 0.4                 # still warming at epoch 5
+    assert np.isclose(float(sched(20 * 100)), 0.4)     # full lr after warmup
+    assert np.isclose(float(sched(50 * 100)), 0.04)    # x0.1 after epoch 40
+    assert np.isclose(float(sched(80 * 100)), 0.004)   # x0.01 after epoch 70
+
+
+def test_all_scheduler_types_build():
+    for name in (
+        "TriangularSchedule",
+        "TrapezoidalSchedule",
+        "ImageNetLRDropsWarmup",
+        "MultiStepLRWarmup",
+        "OneCycleLR",
+        "ScheduleFree",
+    ):
+        sched = create_schedule(name, 0.1, epochs=2, steps_per_epoch=5)
+        v = float(sched(3))
+        assert 0.0 <= v <= 0.1 + 1e-6
+
+
+# ---------------------------------------------------------------------------
+# optimizer parity: optax chain vs torch.optim.SGD semantics
+
+
+def test_sgd_matches_torch():
+    torch = pytest.importorskip("torch")
+    rng = np.random.RandomState(0)
+    w0 = rng.randn(4, 3).astype(np.float32)
+    lr, mom, wd = 0.1, 0.9, 5e-4
+
+    tw = torch.nn.Parameter(torch.tensor(w0.copy()))
+    topt = torch.optim.SGD([tw], lr=lr, momentum=mom, weight_decay=wd)
+
+    tx = sgd(lr, momentum=mom, weight_decay=wd)
+    jw = jnp.asarray(w0)
+    state = tx.init(jw)
+
+    for i in range(5):
+        g = rng.randn(4, 3).astype(np.float32)
+        topt.zero_grad()
+        tw.grad = torch.tensor(g)
+        topt.step()
+        updates, state = tx.update(jnp.asarray(g), state, jw)
+        jw = optax.apply_updates(jw, updates)
+        np.testing.assert_allclose(
+            np.asarray(jw), tw.detach().numpy(), rtol=1e-5, atol=1e-6
+        )
+
+
+# ---------------------------------------------------------------------------
+# train/eval step semantics on a tiny model
+
+
+@pytest.fixture(scope="module")
+def tiny_setup():
+    model = create_model("resnet18", num_classes=10, dataset_name="CIFAR10")
+    tx = sgd(0.1, momentum=0.9, weight_decay=5e-4)
+    state = create_train_state(
+        model, tx, jax.random.key(0), input_shape=(2, 16, 16, 3)
+    )
+    images = jax.random.normal(jax.random.key(1), (8, 16, 16, 3))
+    labels = jnp.arange(8) % 10
+    return model, tx, state, (images, labels)
+
+
+def test_train_step_reduces_loss(tiny_setup):
+    model, tx, state, batch = tiny_setup
+    train_step = jax.jit(make_train_step(model, tx, schedule=lambda s: 0.1))
+    losses = []
+    for _ in range(8):
+        state, metrics = train_step(state, batch)
+        losses.append(float(metrics["loss_sum"] / metrics["count"]))
+    assert losses[-1] < losses[0]
+    assert int(state.step) == 8
+    assert "lr" in metrics
+
+
+def test_masked_forward_ignores_masked_weights(tiny_setup):
+    model, tx, state, batch = tiny_setup
+    # zero out half of conv1's mask; then perturb those weights wildly —
+    # the masked forward must not change (mask*weight semantics).
+    masks = mask_where(
+        state.masks,
+        lambda m: jnp.zeros_like(m)
+        if m.shape == state.params["conv1"]["kernel"].shape
+        else m,
+    )
+    # align: only kill conv1's mask
+    masks = jax.tree_util.tree_map_with_path(
+        lambda p, m: (
+            jnp.zeros_like(m)
+            if m is not None and "conv1" in str(p)
+            else m
+        ),
+        state.masks,
+        is_leaf=lambda x: x is None,
+    )
+    s1 = state.replace(masks=masks)
+    eval_step = jax.jit(make_eval_step(model))
+    out1 = eval_step(s1, batch)
+
+    poisoned = jax.tree_util.tree_map_with_path(
+        lambda p, w: w + 100.0 if "conv1" in str(p) and "kernel" in str(p) else w,
+        state.params,
+    )
+    out2 = eval_step(s1.replace(params=poisoned), batch)
+    np.testing.assert_allclose(
+        float(out1["loss_sum"]), float(out2["loss_sum"]), rtol=1e-5
+    )
+
+
+def test_masked_weights_only_get_decay_updates(tiny_setup):
+    """Masked weights receive no data gradient — only wd/momentum drift
+    (reference semantics, SURVEY.md §3.3)."""
+    model, tx, state, batch = tiny_setup
+    masks = jax.tree_util.tree_map_with_path(
+        lambda p, m: (
+            jnp.zeros_like(m) if m is not None and "conv1" in str(p) else m
+        ),
+        state.masks,
+        is_leaf=lambda x: x is None,
+    )
+    state = state.replace(masks=masks)
+    w_before = state.params["conv1"]["kernel"]
+    train_step = jax.jit(make_train_step(model, tx))
+    new_state, _ = train_step(state, batch)
+    w_after = new_state.params["conv1"]["kernel"]
+    # pure weight decay step: w -= lr * wd * w
+    expected = w_before * (1.0 - 0.1 * 5e-4)
+    np.testing.assert_allclose(
+        np.asarray(w_after), np.asarray(expected), rtol=1e-5, atol=1e-7
+    )
+
+
+def test_eval_step_counts(tiny_setup):
+    model, tx, state, batch = tiny_setup
+    eval_step = jax.jit(make_eval_step(model))
+    out = eval_step(state, batch)
+    assert float(out["count"]) == 8.0
+    assert 0.0 <= float(out["correct"]) <= 8.0
+
+
+def test_reset_optimizer_zeroes_step_and_momentum(tiny_setup):
+    model, tx, state, batch = tiny_setup
+    train_step = jax.jit(make_train_step(model, tx))
+    s, _ = train_step(state, batch)
+    s2 = reset_optimizer(s, tx)
+    assert int(s2.step) == 0
+    # params survive the reset
+    np.testing.assert_allclose(
+        np.asarray(s.params["fc"]["kernel"]),
+        np.asarray(s2.params["fc"]["kernel"]),
+    )
+
+
+def test_schedule_free_optimizer_builds(tiny_setup):
+    model, _, _, batch = tiny_setup
+    tx = create_optimizer("ScheduleFreeSGD", 0.1, momentum=0.9)
+    state = create_train_state(
+        model, tx, jax.random.key(2), input_shape=(2, 16, 16, 3)
+    )
+    train_step = jax.jit(make_train_step(model, tx))
+    s, metrics = train_step(state, batch)
+    assert np.isfinite(float(metrics["loss_sum"]))

@@ -180,7 +180,12 @@ def per_layer_threshold_mask(scores: PyTree, densities: dict[str, float]) -> PyT
         n = s.size
         k = int((1.0 - d) * n)
         if k <= 0:
-            return jnp.ones_like(s, dtype=jnp.bool_)
+            # Keep every position with a positive score. Scores at
+            # already-pruned positions are exactly 0 (callers multiply by the
+            # mask), so a density-1 layer keeps its existing mask rather than
+            # resurrecting pruned weights — the reference's k==0 threshold-0
+            # behavior (pruning_utils.py:137-143).
+            return s > 0.0
         flat = jnp.sort(s.reshape(-1).astype(jnp.float32))
         threshold = flat[k - 1]
         return s > threshold

@@ -1,0 +1,41 @@
+"""SPMD layer: device mesh, sharded steps, multi-host coordination."""
+
+from .mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    batch_sharding,
+    create_mesh,
+    make_sharded_eval_step,
+    make_sharded_train_step,
+    replicate,
+    replicated,
+    shard_batch,
+)
+from .multihost import (
+    broadcast_object,
+    check_state_equality,
+    initialize_distributed,
+    is_primary,
+    process_index,
+    sync_hosts,
+    tree_fingerprint,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "create_mesh",
+    "batch_sharding",
+    "replicated",
+    "replicate",
+    "shard_batch",
+    "make_sharded_train_step",
+    "make_sharded_eval_step",
+    "initialize_distributed",
+    "is_primary",
+    "process_index",
+    "broadcast_object",
+    "check_state_equality",
+    "sync_hosts",
+    "tree_fingerprint",
+]

@@ -1,0 +1,87 @@
+"""Device mesh + SPMD step wiring.
+
+The reference distributes with DDP over NCCL: one replica per GPU, bucketed
+gradient allreduce inside ``loss.backward()`` (base_harness.py:81,127). The
+TPU-native design is SPMD under one jit: a ``Mesh`` over all devices with a
+``data`` axis (and a ``model`` axis left open for tensor/sequence sharding),
+the batch sharded on ``data``, state replicated, and the gradient psum
+inserted by XLA's partitioner — collectives ride ICI, no NCCL-style
+process-group code at all (SURVEY.md §5 "Distributed communication
+backend").
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PyTree = Any
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def create_mesh(
+    num_devices: int = 0,
+    model_parallelism: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Mesh of shape (data, model). ``num_devices=0`` = all visible devices;
+    model axis defaults to 1 (pure DP — the reference's only strategy,
+    SURVEY.md §2.3) but is first-class so tensor/sequence sharding can use
+    the same mesh."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if num_devices:
+        devs = devs[:num_devices]
+    n = len(devs)
+    if n % model_parallelism:
+        raise ValueError(
+            f"{n} devices not divisible by model_parallelism={model_parallelism}"
+        )
+    grid = np.array(devs).reshape(n // model_parallelism, model_parallelism)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch dim sharded over data axis; replicated over model."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(batch: PyTree, mesh: Mesh) -> PyTree:
+    """Place a host-global batch sharded on the data axis."""
+    return jax.device_put(batch, batch_sharding(mesh))
+
+
+def replicate(tree: PyTree, mesh: Mesh) -> PyTree:
+    return jax.device_put(tree, replicated(mesh))
+
+
+def make_sharded_train_step(
+    train_step: Callable, mesh: Mesh, donate_state: bool = True
+) -> Callable:
+    """jit the pure step with state replicated and batch data-sharded.
+
+    XLA partitions the fwd/bwd over the batch and inserts the gradient
+    all-reduce — the TPU equivalent of DDP's bucketed NCCL allreduce, but
+    fused into the same program as the optimizer update."""
+    return jax.jit(
+        train_step,
+        in_shardings=(replicated(mesh), batch_sharding(mesh)),
+        out_shardings=(replicated(mesh), replicated(mesh)),
+        donate_argnums=(0,) if donate_state else (),
+    )
+
+
+def make_sharded_eval_step(eval_step: Callable, mesh: Mesh) -> Callable:
+    return jax.jit(
+        eval_step,
+        in_shardings=(replicated(mesh), batch_sharding(mesh)),
+        out_shardings=replicated(mesh),
+    )

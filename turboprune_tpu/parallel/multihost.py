@@ -1,0 +1,111 @@
+"""Multi-host coordination.
+
+Replaces the reference's NCCL process-group utilities
+(/root/reference/utils/distributed_utils.py): ``setup_distributed`` becomes
+``jax.distributed.initialize``; ``broadcast_object`` (rank-0 strings like the
+run id and experiment dir, run_experiment.py:70-72) becomes a
+``broadcast_one_to_all`` over encoded bytes; and the reference's dormant
+``check_model_equality`` (distributed_utils.py:31-60 — written but never
+called) is revived as a real post-prune assertion, because the TPU design
+computes masks replicated on every host and key-discipline bugs would
+otherwise diverge silently (SURVEY.md §5 race-detection note).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PyTree = Any
+
+
+def initialize_distributed() -> None:
+    """Join the multi-host world when launched under a JAX cluster
+    (coordinator env vars / TPU metadata present); no-op single-host.
+    The TPU analog of dist.init_process_group("nccl")
+    (distributed_utils.py:63-66) — after this, collectives ride ICI/DCN."""
+    if jax.process_count() > 1:
+        return  # already initialized by the runtime
+    if os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
+        "COORDINATOR_ADDRESS"
+    ):
+        jax.distributed.initialize()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def is_primary() -> bool:
+    """Host 0 — the reference's rank-0 role (logging, expt dir, checkpoints)."""
+    return jax.process_index() == 0
+
+
+def broadcast_object(obj: Any) -> Any:
+    """Host-0's JSON-serializable object to all hosts
+    (reference broadcast_object, distributed_utils.py:7-11)."""
+    if jax.process_count() == 1:
+        return obj
+    from jax.experimental import multihost_utils
+
+    payload = np.frombuffer(
+        json.dumps(obj if is_primary() else None).encode(), dtype=np.uint8
+    )
+    # Fixed-size buffer: length first, then padded payload.
+    length = multihost_utils.broadcast_one_to_all(
+        np.array([payload.size], np.int32)
+    )[0]
+    buf = np.zeros(int(length), np.uint8)
+    if is_primary():
+        buf[: payload.size] = payload
+    out = multihost_utils.broadcast_one_to_all(buf)
+    return json.loads(out.tobytes().decode())
+
+
+def tree_fingerprint(tree: PyTree) -> str:
+    """Deterministic content hash of every array leaf (order-stable)."""
+    h = hashlib.sha256()
+    for path, leaf in jax.tree_util.tree_flatten_with_path(
+        tree, is_leaf=lambda x: x is None
+    )[0]:
+        if leaf is None:
+            continue
+        h.update(str(path).encode())
+        h.update(np.asarray(jax.device_get(leaf)).tobytes())
+    return h.hexdigest()
+
+
+def check_state_equality(tree: PyTree, what: str = "state") -> None:
+    """Assert all hosts hold identical replicated state; raises on divergence.
+
+    Upgrade of the reference's never-called check_model_equality
+    (distributed_utils.py:31-60): hash params+masks locally, allgather the
+    digests, compare."""
+    digest = tree_fingerprint(tree)
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    fp = np.frombuffer(bytes.fromhex(digest), dtype=np.uint8)
+    all_fps = multihost_utils.process_allgather(fp)
+    ref = np.asarray(all_fps)[0]
+    for i, other in enumerate(np.asarray(all_fps)):
+        if not np.array_equal(ref, other):
+            raise RuntimeError(
+                f"{what} diverged across hosts: host 0 != host {i}. "
+                "Replicated pruning requires identical PRNG keys on every host."
+            )
+
+
+def sync_hosts(name: str = "barrier") -> None:
+    """Cross-host barrier (reference dist.barrier, distributed_utils.py:27)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)

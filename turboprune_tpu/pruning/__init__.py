@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.masking import PyTree, apply_masks
+from ..train.steps import cross_entropy_sum
 from . import criteria, densities
 from .criteria import (
     balanced_densities,
@@ -34,8 +35,8 @@ DATA_DRIVEN_METHODS = ("snip", "synflow")
 
 
 def softmax_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
-    return -jnp.take_along_axis(logp, labels[:, None], axis=1).mean()
+    """Mean CE over the batch (shared fp32 kernel from the train layer)."""
+    return cross_entropy_sum(logits, labels) / logits.shape[0]
 
 
 def prune_the_model(

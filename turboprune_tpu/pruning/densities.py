@@ -83,12 +83,8 @@ def generate_cyclical_schedule(
 
     total = sum(epochs)
     if total > epochs_per_level:
+        # Floor-rescale; sum(floor(e*scale)) <= budget always holds after
+        # this, so no further correction is needed.
         scale = epochs_per_level / total
         epochs = [int(e * scale) for e in epochs]
-        excess = sum(epochs) - epochs_per_level
-        if excess > 0:
-            per, rem = divmod(excess, len(epochs))
-            epochs = [e - per for e in epochs]
-            for i in range(rem):
-                epochs[i] -= 1
     return epochs

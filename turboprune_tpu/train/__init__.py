@@ -1,0 +1,33 @@
+"""Training layer: state pytree, optimizers, LR schedules, jitted steps."""
+
+from .optim import create_optimizer, eval_params, schedule_free_sgd, sgd
+from .schedules import (
+    create_schedule,
+    imagenet_lr_drops_warmup,
+    multistep_warmup_schedule,
+    onecycle_schedule,
+    trapezoidal_schedule,
+    triangular_schedule,
+)
+from .state import TrainState, create_train_state, init_variables, reset_optimizer
+from .steps import cross_entropy_sum, make_eval_step, make_train_step
+
+__all__ = [
+    "TrainState",
+    "create_train_state",
+    "init_variables",
+    "reset_optimizer",
+    "create_optimizer",
+    "eval_params",
+    "sgd",
+    "schedule_free_sgd",
+    "create_schedule",
+    "triangular_schedule",
+    "trapezoidal_schedule",
+    "multistep_warmup_schedule",
+    "imagenet_lr_drops_warmup",
+    "onecycle_schedule",
+    "make_train_step",
+    "make_eval_step",
+    "cross_entropy_sum",
+]

@@ -1,0 +1,81 @@
+"""Optimizer factory.
+
+Reference surface: torch ``SGD(lr, momentum, weight_decay)`` or
+``schedulefree.SGDScheduleFree`` (standard_pruning_harness.py:52-75). Here:
+optax chains with torch-matching update order — weight decay is added to the
+gradient BEFORE the momentum trace (torch SGD semantics), and decay hits ALL
+params including masked weights and BatchNorm scale/bias, preserving the
+reference's "masked weights keep drifting under momentum + wd" behavior
+(SURVEY.md §3.3 note).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import optax
+
+ScalarOrSchedule = Union[float, Callable]
+
+
+def sgd(
+    learning_rate: ScalarOrSchedule,
+    momentum: float = 0.9,
+    weight_decay: float = 0.0,
+) -> optax.GradientTransformation:
+    """torch.optim.SGD equivalent: g += wd*w; buf = mu*buf + g; w -= lr*buf."""
+    parts = []
+    if weight_decay:
+        parts.append(optax.add_decayed_weights(weight_decay))
+    if momentum:
+        parts.append(optax.trace(decay=momentum, nesterov=False))
+    parts.append(optax.scale_by_learning_rate(learning_rate))
+    return optax.chain(*parts)
+
+
+def adamw(
+    learning_rate: ScalarOrSchedule,
+    weight_decay: float = 0.0,
+    b1: float = 0.9,
+    b2: float = 0.999,
+) -> optax.GradientTransformation:
+    return optax.adamw(learning_rate, b1=b1, b2=b2, weight_decay=weight_decay)
+
+
+def schedule_free_sgd(
+    learning_rate: ScalarOrSchedule,
+    momentum: float = 0.9,
+    weight_decay: float = 0.0,
+) -> optax.GradientTransformation:
+    """Schedule-free SGD (Defazio et al.) — parity with the reference's
+    ``schedulefree.SGDScheduleFree`` branch. Eval must read the averaged
+    params via ``eval_params`` (the reference calls optimizer.eval()/train()
+    around evaluation, base_harness.py analog)."""
+    base = sgd(learning_rate, momentum=0.0, weight_decay=weight_decay)
+    return optax.contrib.schedule_free(base, learning_rate=learning_rate, b1=momentum)
+
+
+def eval_params(opt_state, params):
+    """Parameters to evaluate with: schedule-free averaged params when the
+    wrapper is active, the raw params otherwise."""
+    try:
+        return optax.contrib.schedule_free_eval_params(opt_state, params)
+    except Exception:
+        return params
+
+
+def create_optimizer(
+    optimizer_name: str,
+    learning_rate: ScalarOrSchedule,
+    momentum: float = 0.9,
+    weight_decay: float = 0.0,
+) -> optax.GradientTransformation:
+    """Factory keyed by config optimizer_name
+    (standard_pruning_harness.py:52-75 dispatch)."""
+    if optimizer_name == "SGD":
+        return sgd(learning_rate, momentum, weight_decay)
+    if optimizer_name == "AdamW":
+        return adamw(learning_rate, weight_decay)
+    if optimizer_name == "ScheduleFreeSGD":
+        return schedule_free_sgd(learning_rate, momentum, weight_decay)
+    raise ValueError(f"Unknown optimizer_name: {optimizer_name}")

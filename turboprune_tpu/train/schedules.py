@@ -1,0 +1,155 @@
+"""Learning-rate schedules as pure ``step -> lr`` functions for optax.
+
+Rebuilds the reference's scheduler zoo (/root/reference/utils/schedulers.py)
+as optax-style schedules. The reference wraps torch ``LambdaLR``/``MultiStepLR``
+objects and steps some of them per-step and some per-epoch
+(base_harness.py:178-188); here every schedule is a pure function of the
+global *step* count, which is the natural unit under jit (the step index is
+already traced in the optimizer state — no host-side ``scheduler.step()``
+bookkeeping, no per-level scheduler objects to rebuild).
+
+Schedules provided (reference parity):
+  TriangularSchedule     piecewise-linear 0.2 -> 1 -> 0 peak at the warmup
+                         boundary (schedulers.py:79-117)
+  TrapezoidalSchedule    linear warmup, flat, linear cooldown
+                         (schedulers.py:65-77,120-143)
+  ImageNetLRDropsWarmup  linear warmup over 10 epochs then x0.1 drops at
+                         epochs 40 and 70 (schedulers.py:37-62)
+  MultiStepLRWarmup      linear warmup over warmup_fraction then x0.1 drops
+                         at epochs 60 and 120 (schedulers.py:8-34) — the
+                         config Literal the reference advertises but never
+                         implements (SURVEY.md §2.1); implemented here
+  OneCycleLR             optax cosine one-cycle (torch OneCycleLR equivalent)
+  ScheduleFree           constant lr; pairs with the schedule-free optimizer
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax.numpy as jnp
+import optax
+
+Schedule = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+def triangular_schedule(
+    base_lr: float, total_steps: int, warmup_fraction: float = 0.2
+) -> Schedule:
+    """lr(step) = base_lr * interp(step; [0, warmup, total] -> [0.2, 1, 0]).
+
+    Matches the reference's LambdaLR over np.interp with knots
+    (0, warmup_steps, total_steps) and values (0.2, 1.0, 0.0)
+    (schedulers.py:96-113)."""
+    warmup_steps = max(int(total_steps * warmup_fraction), 1)
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        factor = jnp.interp(
+            step,
+            jnp.array([0.0, float(warmup_steps), float(total_steps)]),
+            jnp.array([0.2, 1.0, 0.0]),
+        )
+        return base_lr * factor
+
+    return schedule
+
+
+def trapezoidal_schedule(
+    base_lr: float,
+    total_steps: int,
+    warmup_steps: int,
+    cooldown_steps: int,
+) -> Schedule:
+    """Linear warmup to base_lr, flat plateau, linear cooldown to 0 —
+    the reference's ``step_trapezoidal`` piecewise form (schedulers.py:65-77)."""
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = (step + 1.0) / float(max(warmup_steps, 1))
+        cool = (float(total_steps) - step) / float(max(cooldown_steps, 1))
+        return base_lr * jnp.clip(jnp.minimum(warm, cool), 0.0, 1.0)
+
+    return schedule
+
+
+def multistep_warmup_schedule(
+    base_lr: float,
+    steps_per_epoch: int,
+    warmup_epochs: int,
+    milestones_epochs: Sequence[int],
+    gamma: float = 0.1,
+) -> Schedule:
+    """Linear warmup for ``warmup_epochs`` then multiplicative ``gamma`` drops
+    at each milestone epoch (reference warmup + MultiStepLR composition,
+    schedulers.py:8-34,37-62)."""
+    warmup_steps = max(warmup_epochs * steps_per_epoch, 1)
+    boundaries = jnp.array(
+        [float(m * steps_per_epoch) for m in milestones_epochs], jnp.float32
+    )
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = jnp.clip((step + 1.0) / warmup_steps, 0.0, 1.0)
+        drops = jnp.power(gamma, jnp.sum(step >= boundaries))
+        return base_lr * warm * drops
+
+    return schedule
+
+
+def imagenet_lr_drops_warmup(
+    base_lr: float, steps_per_epoch: int
+) -> Schedule:
+    """The reference's ImageNet recipe: 10-epoch linear warmup, x0.1 drops at
+    epochs 40 and 70 (schedulers.py:37-62)."""
+    return multistep_warmup_schedule(
+        base_lr, steps_per_epoch, warmup_epochs=10, milestones_epochs=(40, 70)
+    )
+
+
+def onecycle_schedule(base_lr: float, total_steps: int) -> Schedule:
+    """Cosine one-cycle (torch OneCycleLR defaults: pct_start 0.3,
+    div_factor 25, final_div_factor 1e4)."""
+    return optax.cosine_onecycle_schedule(
+        transition_steps=total_steps,
+        peak_value=base_lr,
+        pct_start=0.3,
+        div_factor=25.0,
+        final_div_factor=1e4,
+    )
+
+
+def constant_schedule(base_lr: float) -> Schedule:
+    return optax.constant_schedule(base_lr)
+
+
+def create_schedule(
+    scheduler_type: str,
+    base_lr: float,
+    epochs: int,
+    steps_per_epoch: int,
+    warmup_fraction: float = 0.2,
+) -> Schedule:
+    """Factory keyed by the config's scheduler_type literal
+    (reference _setup_scheduler dispatch, standard_pruning_harness.py:86-119)."""
+    total_steps = max(epochs * steps_per_epoch, 1)
+    if scheduler_type == "TriangularSchedule":
+        return triangular_schedule(base_lr, total_steps, warmup_fraction)
+    if scheduler_type == "TrapezoidalSchedule":
+        warmup = int(total_steps * warmup_fraction)
+        cooldown = int(total_steps * warmup_fraction)
+        return trapezoidal_schedule(base_lr, total_steps, warmup, cooldown)
+    if scheduler_type == "ImageNetLRDropsWarmup":
+        return imagenet_lr_drops_warmup(base_lr, steps_per_epoch)
+    if scheduler_type == "MultiStepLRWarmup":
+        return multistep_warmup_schedule(
+            base_lr,
+            steps_per_epoch,
+            warmup_epochs=max(int(epochs * warmup_fraction), 1),
+            milestones_epochs=(60, 120),
+        )
+    if scheduler_type == "OneCycleLR":
+        return onecycle_schedule(base_lr, total_steps)
+    if scheduler_type == "ScheduleFree":
+        return constant_schedule(base_lr)
+    raise ValueError(f"Unknown scheduler_type: {scheduler_type}")

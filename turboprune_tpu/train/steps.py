@@ -1,0 +1,119 @@
+"""Jitted train / eval steps.
+
+The reference's hot loop is ``train_step``: forward under bf16 autocast,
+CE loss, backward (DDP allreduce fires inside), optimizer step
+(base_harness.py:115-134). Here the whole thing is ONE pure function
+``(state, batch) -> (state, metrics)`` that jit compiles to a single fused
+XLA program: the mask multiply folds into each conv's operand, the psum over
+the data axis is inserted by the partitioner, and donation makes the update
+in-place in HBM. No autocast machinery — the model's compute dtype is bf16
+by construction and params/optimizer stay fp32 (the reference's AMP policy,
+base_harness.py:92-101, without the amp plumbing).
+
+Metrics come back as global SUMS (loss*n, correct, n) so the host can
+accumulate exact epoch averages without per-step device syncs — replacing
+torchmetrics' dist_sync_on_step + loss all_reduce AVG
+(base_harness.py:54-60,192-200) with arithmetic that is already correct
+under the jit partitioner.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..ops.masking import PyTree, apply_masks
+from .state import TrainState
+
+Batch = tuple[jax.Array, jax.Array]  # (images NHWC, integer labels)
+
+
+def cross_entropy_sum(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Summed CE in fp32 (mean is taken on the host over exact counts)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    return -jnp.take_along_axis(logp, labels[:, None], axis=1).sum()
+
+
+def _forward_train(model, params, masks, batch_stats, images, rng):
+    variables = {"params": apply_masks(params, masks)}
+    mutable = []
+    if batch_stats:
+        variables["batch_stats"] = batch_stats
+        mutable = ["batch_stats"]
+    out = model.apply(
+        variables, images, train=True, mutable=mutable, rngs={"dropout": rng}
+    )
+    if mutable:
+        logits, new_model_state = out
+        return logits, new_model_state.get("batch_stats", {})
+    return out, batch_stats
+
+
+def make_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    schedule: Optional[Callable] = None,
+) -> Callable[[TrainState, Batch], tuple[TrainState, dict]]:
+    """Build the pure train step. Loss gradient is taken wrt the RAW params —
+    the mask multiply inside the forward means masked weights get zero
+    data-gradient but still receive weight-decay/momentum updates, exactly
+    the reference's semantics (SURVEY.md §3.3)."""
+
+    def train_step(state: TrainState, batch: Batch) -> tuple[TrainState, dict]:
+        images, labels = batch
+        step_rng = jax.random.fold_in(state.rng, state.step)
+
+        def loss_fn(params):
+            logits, new_batch_stats = _forward_train(
+                model, params, state.masks, state.batch_stats, images, step_rng
+            )
+            n = jnp.asarray(labels.shape[0], jnp.float32)
+            loss_sum = cross_entropy_sum(logits, labels)
+            return loss_sum / n, (logits, new_batch_stats, loss_sum, n)
+
+        grads, (logits, new_batch_stats, loss_sum, n) = jax.grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+
+        correct = jnp.sum(jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
+        metrics = {"loss_sum": loss_sum, "correct": correct, "count": n}
+        if schedule is not None:
+            metrics["lr"] = jnp.asarray(schedule(state.step), jnp.float32)
+
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            batch_stats=new_batch_stats,
+            opt_state=new_opt_state,
+        )
+        return new_state, metrics
+
+    return train_step
+
+
+def make_eval_step(model) -> Callable[[TrainState, Batch], dict]:
+    """Pure eval step (reference test_step, base_harness.py:136-149).
+
+    For schedule-free optimizers evaluate with the averaged weights by
+    passing ``state.replace(params=optim.eval_params(opt_state, params))``."""
+
+    def eval_step(state: TrainState, batch: Batch) -> dict:
+        images, labels = batch
+        variables = {"params": apply_masks(state.params, state.masks)}
+        if state.batch_stats:
+            variables["batch_stats"] = state.batch_stats
+        logits = model.apply(variables, images, train=False)
+        n = jnp.asarray(labels.shape[0], jnp.float32)
+        correct = jnp.sum(jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
+        return {
+            "loss_sum": cross_entropy_sum(logits, labels),
+            "correct": correct,
+            "count": n,
+        }
+
+    return eval_step
