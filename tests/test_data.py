@@ -1,0 +1,180 @@
+"""Data-layer tests: augmentation semantics, loader contract, grain
+pipeline on a tiny fake ImageFolder (SURVEY.md §4 — the reference has no
+tests; these pin the airbench/FFCV-equivalent behaviors)."""
+
+import io
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from turboprune_tpu.data import (
+    DeviceCifarLoader,
+    SyntheticLoaders,
+    synthetic_arrays,
+)
+from turboprune_tpu.data.augment import (
+    augment_epoch,
+    batch_cutout,
+    batch_flip_lr,
+    batch_translate_crop,
+    normalize_uint8,
+    pad_reflect,
+)
+
+
+def _images(n=8, s=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.integers(0, 256, size=(n, s, s, 3), dtype=np.uint8))
+
+
+class TestAugment:
+    def test_normalize_uint8_range(self):
+        x = normalize_uint8(_images(), (0.5, 0.5, 0.5), (0.25, 0.25, 0.25))
+        assert x.dtype == jnp.float32
+        assert float(jnp.max(jnp.abs(x))) <= 2.0 + 1e-6
+
+    def test_flip_is_mirror_or_identity_per_image(self):
+        x = normalize_uint8(_images(), (0, 0, 0), (1, 1, 1))
+        y = batch_flip_lr(x, jax.random.PRNGKey(0))
+        for i in range(x.shape[0]):
+            same = bool(jnp.allclose(y[i], x[i]))
+            flipped = bool(jnp.allclose(y[i], x[i, :, ::-1, :]))
+            assert same or flipped
+
+    def test_translate_crop_content_comes_from_padded(self):
+        x = normalize_uint8(_images(n=4, s=8), (0, 0, 0), (1, 1, 1))
+        padded = pad_reflect(x, 2)
+        out = batch_translate_crop(padded, jax.random.PRNGKey(1), 8)
+        assert out.shape == x.shape
+        # each output must equal SOME (sy, sx) window of its padded input
+        for i in range(4):
+            found = any(
+                bool(jnp.allclose(out[i], padded[i, sy : sy + 8, sx : sx + 8, :]))
+                for sy in range(5)
+                for sx in range(5)
+            )
+            assert found
+
+    def test_cutout_zeroes_exactly_one_square(self):
+        x = jnp.ones((4, 8, 8, 3), jnp.float32)
+        out = batch_cutout(x, jax.random.PRNGKey(2), 3)
+        for i in range(4):
+            zeros = int(jnp.sum(out[i] == 0.0))
+            assert zeros == 3 * 3 * 3
+
+    def test_altflip_flips_whole_set_on_odd_epochs(self):
+        x = normalize_uint8(_images(n=4, s=8), (0, 0, 0), (1, 1, 1))
+        k = jax.random.PRNGKey(3)
+        even = augment_epoch(
+            x, k, jnp.asarray(0), crop_size=8, flip=True, translate=0, altflip=True
+        )
+        odd = augment_epoch(
+            x, k, jnp.asarray(1), crop_size=8, flip=True, translate=0, altflip=True
+        )
+        assert bool(jnp.allclose(odd, even[:, :, ::-1, :]))
+
+
+class TestDeviceLoader:
+    def _loader(self, train=True, n=64, bs=16, **kw):
+        x, y = synthetic_arrays(n, 8, 4, seed=0)
+        aug = {"flip": True, "translate": 2} if train else None
+        return DeviceCifarLoader(
+            x, y, bs, train=train, aug=aug, seed=0, **kw
+        )
+
+    def test_train_epoch_shapes_and_count(self):
+        loader = self._loader(n=70, bs=16)
+        batches = list(loader)
+        assert len(batches) == len(loader) == 70 // 16
+        for imgs, labels in batches:
+            assert imgs.shape == (16, 8, 8, 3)
+            assert labels.shape == (16,)
+            assert labels.dtype == jnp.int32
+
+    def test_test_loader_keeps_last_partial_and_order(self):
+        loader = self._loader(train=False, n=70, bs=16)
+        batches = list(loader)
+        assert len(batches) == 5  # ceil(70/16)
+        assert batches[-1][0].shape[0] == 70 - 4 * 16
+        # no shuffle: labels concatenate back to the original order
+        x, y = synthetic_arrays(70, 8, 4, seed=0)
+        got = np.concatenate([np.asarray(b[1]) for b in batches])
+        np.testing.assert_array_equal(got, y)
+
+    def test_shuffle_differs_across_epochs_but_same_multiset(self):
+        loader = self._loader(n=64, bs=64)
+        (imgs1, labels1), = list(loader)
+        (imgs2, labels2), = list(loader)
+        assert not bool(jnp.array_equal(labels1, labels2))
+        np.testing.assert_array_equal(
+            np.sort(np.asarray(labels1)), np.sort(np.asarray(labels2))
+        )
+
+    def test_unknown_aug_key_rejected(self):
+        x, y = synthetic_arrays(8, 8, 2, seed=0)
+        with pytest.raises(ValueError, match="Unrecognized"):
+            DeviceCifarLoader(x, y, 4, train=True, aug={"mixup": 1})
+
+
+class TestSyntheticLoaders:
+    def test_contract(self):
+        loaders = SyntheticLoaders(
+            "CIFAR10", batch_size=32, image_size=8, num_classes=10,
+            num_train=128, num_test=64, seed=0,
+        )
+        assert loaders.num_classes == 10
+        imgs, labels = next(iter(loaders.train_loader))
+        assert imgs.shape == (32, 8, 8, 3)
+        assert int(labels.min()) >= 0 and int(labels.max()) < 10
+
+    def test_deterministic_given_seed(self):
+        a = synthetic_arrays(16, 8, 4, seed=7)
+        b = synthetic_arrays(16, 8, 4, seed=7)
+        np.testing.assert_array_equal(a[0], b[0])
+        np.testing.assert_array_equal(a[1], b[1])
+
+
+class TestGrainImageNet:
+    @pytest.fixture(scope="class")
+    def fake_imagefolder(self, tmp_path_factory):
+        from PIL import Image
+
+        root = tmp_path_factory.mktemp("imagenet")
+        rng = np.random.default_rng(0)
+        for split, per_class in (("train", 6), ("val", 3)):
+            for cls in ("n01", "n02"):
+                d = root / split / cls
+                d.mkdir(parents=True)
+                for i in range(per_class):
+                    arr = rng.integers(0, 256, size=(40, 52, 3), dtype=np.uint8)
+                    Image.fromarray(arr).save(d / f"img_{i}.jpeg")
+        return root
+
+    def test_pipeline_shapes_and_labels(self, fake_imagefolder):
+        from turboprune_tpu.data.imagenet import ImageNetLoaders
+
+        loaders = ImageNetLoaders(
+            str(fake_imagefolder), total_batch_size=4, num_workers=0, seed=0
+        )
+        assert loaders.num_classes == 2
+        imgs, labels = next(iter(loaders.train_loader))
+        assert imgs.shape == (4, 224, 224, 3)
+        assert imgs.dtype == jnp.float32
+        assert set(np.asarray(labels)) <= {0, 1}
+        # val: sequential, keeps partial batches
+        val_batches = list(loaders.test_loader)
+        total = sum(int(b[1].shape[0]) for b in val_batches)
+        assert total == 6
+
+    def test_eval_center_crop_deterministic(self, fake_imagefolder):
+        from turboprune_tpu.data.imagenet import GrainImageLoader
+
+        loader = GrainImageLoader(
+            str(fake_imagefolder / "val"), 2, train=False, num_workers=0, seed=0
+        )
+        a = [np.asarray(b[0]) for b in loader]
+        b = [np.asarray(x[0]) for x in loader]
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)

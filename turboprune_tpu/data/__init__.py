@@ -1,0 +1,88 @@
+"""Input pipelines (reference layer: /root/reference/utils/dataset.py).
+
+Three loader families behind one factory, selected by
+``dataset_params.dataloader_type`` (the reference hardcodes
+airbench-for-CIFAR / FFCV-for-ImageNet in the harness,
+standard_pruning_harness.py:145-157):
+
+  device    whole dataset in HBM, whole-epoch jitted augmentation (CIFAR)
+  grain     multi-process host decode + per-host sharding + device prefetch
+            (ImageNet; the FFCV replacement)
+  synthetic deterministic generated data (zero-egress tests/benches)
+
+All loaders share one contract: ``.train_loader`` / ``.test_loader``
+iterables yielding device-resident ``(images NHWC float, labels int32)``,
+``len(loader)`` = batches per epoch, ``.num_classes``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .augment import (
+    CIFAR10_MEAN,
+    CIFAR10_STD,
+    CIFAR100_MEAN,
+    CIFAR100_STD,
+    IMAGENET_MEAN,
+    IMAGENET_STD,
+    augment_epoch,
+    batch_cutout,
+    batch_flip_lr,
+    batch_translate_crop,
+    normalize_uint8,
+    pad_reflect,
+)
+from .cifar import CifarLoaders, DeviceCifarLoader, cache_cifar_npz, load_cifar_arrays
+from .imagenet import GrainImageLoader, ImageFolderSource, ImageNetLoaders
+from .synthetic import SyntheticLoaders, synthetic_arrays
+
+
+def create_loaders(cfg) -> Any:
+    """Loader factory from a MainConfig (reference _setup_dataloaders,
+    standard_pruning_harness.py:145-157)."""
+    dp = cfg.dataset_params
+    seed = cfg.experiment_params.seed
+    if dp.dataloader_type == "synthetic":
+        return SyntheticLoaders(
+            dataset_name=dp.dataset_name,
+            batch_size=dp.total_batch_size,
+            image_size=dp.image_size,
+            num_classes=dp.num_classes,
+            seed=seed,
+        )
+    if dp.dataloader_type == "device":
+        if dp.dataset_name not in ("CIFAR10", "CIFAR100"):
+            raise ValueError(
+                "dataloader_type=device is for CIFAR; use grain for ImageNet"
+            )
+        return CifarLoaders(
+            data_root_dir=dp.data_root_dir,
+            dataset_name=dp.dataset_name,
+            batch_size=dp.total_batch_size,
+            seed=seed,
+        )
+    if dp.dataloader_type == "grain":
+        return ImageNetLoaders(
+            data_root_dir=dp.data_root_dir,
+            total_batch_size=dp.total_batch_size,
+            num_workers=dp.num_workers,
+            seed=seed,
+            image_size=dp.image_size,
+        )
+    raise ValueError(f"Unknown dataloader_type: {dp.dataloader_type}")
+
+
+__all__ = [
+    "create_loaders",
+    "CifarLoaders",
+    "DeviceCifarLoader",
+    "SyntheticLoaders",
+    "ImageNetLoaders",
+    "GrainImageLoader",
+    "ImageFolderSource",
+    "load_cifar_arrays",
+    "cache_cifar_npz",
+    "synthetic_arrays",
+    "augment_epoch",
+]

@@ -1,0 +1,295 @@
+"""ImageNet input pipeline on grain (the FFCV replacement).
+
+The reference's ImageNet throughput comes from FFCV: compiled JPEG decode,
+memory-mapped .beton files, per-device batch split, distributed shard option
+(/root/reference/utils/dataset.py:347-430, README.md:8). The TPU-native
+equivalent is a grain pipeline: multi-process decode workers feeding
+per-host shards (``ShardByJaxProcess``), with normalization done on device
+in a jitted batched op and a double-buffered device prefetch so the TPU
+never waits on the host.
+
+Pipeline parity (dataset.py:385-430):
+  train: RandomResizedCrop(224) + RandomHorizontalFlip + normalize,
+         RANDOM order, drop_last, seeded
+  val:   CenterCrop(ratio 224/256) + normalize, SEQUENTIAL, keep last
+
+Source format: standard ImageFolder layout (``train/<wnid>/*.JPEG``) read
+as raw bytes and decoded with PIL in grain workers. A packed binary format
+with a native C++ reader is the follow-on optimization; the loader contract
+here is what the harness depends on.
+"""
+
+from __future__ import annotations
+
+import io
+from pathlib import Path
+from typing import Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .augment import IMAGENET_MEAN, IMAGENET_STD
+
+try:  # grain is present in the standard image; gate anyway.
+    import grain.python as grain
+
+    HAS_GRAIN = True
+except Exception:  # pragma: no cover
+    grain = None
+    HAS_GRAIN = False
+
+DEFAULT_CROP_RATIO = 224 / 256  # reference dataset.py:30
+IMAGE_SIZE = 224
+_EXTS = {".jpeg", ".jpg", ".png"}
+
+
+def _index_image_folder(split_dir: Path) -> tuple[list[str], list[int], list[str]]:
+    """(paths, labels, class_names) for an ImageFolder split; classes sorted
+    by name (torchvision/FFCV writer convention)."""
+    classes = sorted(d.name for d in split_dir.iterdir() if d.is_dir())
+    paths: list[str] = []
+    labels: list[int] = []
+    for idx, cls in enumerate(classes):
+        for p in sorted((split_dir / cls).iterdir()):
+            if p.suffix.lower() in _EXTS:
+                paths.append(str(p))
+                labels.append(idx)
+    if not paths:
+        raise FileNotFoundError(f"no images under {split_dir}")
+    return paths, labels, classes
+
+
+class ImageFolderSource:
+    """grain RandomAccessDataSource over an ImageFolder split: returns
+    (jpeg_bytes, label) so decode happens in worker processes."""
+
+    def __init__(self, split_dir: str):
+        self.paths, self.labels, self.classes = _index_image_folder(Path(split_dir))
+
+    def __len__(self) -> int:
+        return len(self.paths)
+
+    def __getitem__(self, i) -> tuple[bytes, int]:
+        with open(self.paths[i], "rb") as f:
+            return f.read(), self.labels[i]
+
+
+def _decode_rgb(data: bytes):
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(data))
+    return img.convert("RGB")
+
+
+def random_resized_crop(
+    img, rng: np.random.Generator, size: int, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)
+):
+    """torchvision-style RandomResizedCrop (FFCV's
+    RandomResizedCropRGBImageDecoder implements the same sampling)."""
+    from PIL import Image
+
+    w, h = img.size
+    area = w * h
+    for _ in range(10):
+        target_area = area * rng.uniform(scale[0], scale[1])
+        log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
+        aspect = np.exp(rng.uniform(*log_ratio))
+        cw = int(round(np.sqrt(target_area * aspect)))
+        ch = int(round(np.sqrt(target_area / aspect)))
+        if 0 < cw <= w and 0 < ch <= h:
+            x = int(rng.integers(0, w - cw + 1))
+            y = int(rng.integers(0, h - ch + 1))
+            return img.resize((size, size), Image.BILINEAR, box=(x, y, x + cw, y + ch))
+    # fallback: center crop of the largest valid aspect-clamped region
+    in_ratio = w / h
+    if in_ratio < ratio[0]:
+        cw, ch = w, int(round(w / ratio[0]))
+    elif in_ratio > ratio[1]:
+        cw, ch = int(round(h * ratio[1])), h
+    else:
+        cw, ch = w, h
+    x, y = (w - cw) // 2, (h - ch) // 2
+    return img.resize((size, size), Image.BILINEAR, box=(x, y, x + cw, y + ch))
+
+
+def center_crop(img, size: int, crop_ratio: float = DEFAULT_CROP_RATIO):
+    """FFCV CenterCropRGBImageDecoder semantics: crop ``crop_ratio *
+    min_side`` centered, then resize to ``size``."""
+    from PIL import Image
+
+    w, h = img.size
+    c = int(round(crop_ratio * min(w, h)))
+    x, y = (w - c) // 2, (h - c) // 2
+    return img.resize((size, size), Image.BILINEAR, box=(x, y, x + c, y + c))
+
+
+if HAS_GRAIN:
+
+    class _TrainTransform(grain.RandomMapTransform):
+        def __init__(self, image_size: int):
+            self.image_size = image_size
+
+        def random_map(self, record, rng: np.random.Generator):
+            data, label = record
+            img = random_resized_crop(_decode_rgb(data), rng, self.image_size)
+            if rng.uniform() < 0.5:
+                img = img.transpose(0)  # PIL FLIP_LEFT_RIGHT == 0
+            return np.asarray(img, np.uint8), np.int32(label)
+
+    class _EvalTransform(grain.MapTransform):
+        def __init__(self, image_size: int):
+            self.image_size = image_size
+
+        def map(self, record):
+            data, label = record
+            img = center_crop(_decode_rgb(data), self.image_size)
+            return np.asarray(img, np.uint8), np.int32(label)
+
+
+@jax.jit
+def _normalize_device(images: jax.Array) -> jax.Array:
+    """uint8 NHWC -> normalized float32 on device (the reference normalizes
+    on GPU inside the FFCV pipeline, dataset.py:390,400)."""
+    from .augment import normalize_uint8
+
+    return normalize_uint8(images, IMAGENET_MEAN, IMAGENET_STD)
+
+
+class GrainImageLoader:
+    """One split: grain DataLoader + device prefetch.
+
+    Per-host batch = total_batch_size / process_count (the reference divides
+    by world size, dataset.py:411); sharding is ``ShardByJaxProcess`` so each
+    host reads a disjoint slice — FFCV's ``distributed=True`` equivalent."""
+
+    def __init__(
+        self,
+        split_dir: str,
+        total_batch_size: int,
+        train: bool,
+        num_workers: int = 16,
+        seed: int = 0,
+        prefetch: int = 2,
+        image_size: int = IMAGE_SIZE,
+    ):
+        if not HAS_GRAIN:  # pragma: no cover
+            raise ImportError("grain is required for the ImageNet pipeline")
+        self.source = ImageFolderSource(split_dir)
+        nproc = jax.process_count()
+        if total_batch_size % nproc:
+            raise ValueError(
+                f"total_batch_size={total_batch_size} not divisible by "
+                f"process_count={nproc}"
+            )
+        self.batch_size = total_batch_size // nproc
+        self.train = train
+        self.num_workers = num_workers
+        self.seed = seed
+        self.prefetch = prefetch
+        self.image_size = image_size
+        self.epoch = 0
+        self._stream: Optional[Iterator] = None  # persistent train iterator
+        shard = grain.ShardByJaxProcess(drop_remainder=train)
+        self._shard_count = shard.shard_count
+        self._shard_samples = len(self.source) // self._shard_count if train else (
+            len(self.source) + self._shard_count - 1
+        ) // self._shard_count
+
+    def __len__(self) -> int:
+        n = self._shard_samples
+        return n // self.batch_size if self.train else -(-n // self.batch_size)
+
+    @property
+    def num_classes(self) -> int:
+        return len(self.source.classes)
+
+    def _make_loader(self, num_epochs: Optional[int]):
+        sampler = grain.IndexSampler(
+            num_records=len(self.source),
+            shard_options=grain.ShardByJaxProcess(drop_remainder=self.train),
+            shuffle=self.train,
+            num_epochs=num_epochs,
+            seed=self.seed,
+        )
+        ops = [
+            _TrainTransform(self.image_size)
+            if self.train
+            else _EvalTransform(self.image_size),
+            grain.Batch(batch_size=self.batch_size, drop_remainder=self.train),
+        ]
+        return grain.DataLoader(
+            data_source=self.source,
+            sampler=sampler,
+            operations=ops,
+            worker_count=self.num_workers,
+        )
+
+    def _raw_batches(self) -> Iterator:
+        """Host-side uint8 batches for one epoch.
+
+        Train: ONE persistent DataLoader over an endless seeded stream
+        (grain reshuffles per pass); an epoch is the next ``len(self)``
+        batches — decode workers are spawned once for the whole run instead
+        of per epoch. Eval: a fresh single-pass sequential loader each call
+        so partial-batch/epoch alignment stays exact."""
+        if self.train:
+            if self._stream is None:
+                self._stream = iter(self._make_loader(num_epochs=None))
+            for _ in range(len(self)):
+                yield next(self._stream)
+        else:
+            yield from self._make_loader(num_epochs=1)
+
+    def __iter__(self) -> Iterator[tuple[jax.Array, jax.Array]]:
+        """Yield device-resident (normalized images, labels), keeping
+        ``prefetch`` batches in flight (async dispatch makes device_put +
+        normalize overlap the previous step's compute)."""
+        self.epoch += 1
+        queue: list[tuple[jax.Array, jax.Array]] = []
+        for images, labels in self._raw_batches():
+            queue.append(
+                (
+                    _normalize_device(jnp.asarray(images)),
+                    jnp.asarray(labels, jnp.int32),
+                )
+            )
+            if len(queue) > self.prefetch:
+                yield queue.pop(0)
+        yield from queue
+
+
+class ImageNetLoaders:
+    """Train/val pair (reference FFCVImagenet, dataset.py:347-430)."""
+
+    def __init__(
+        self,
+        data_root_dir: str,
+        total_batch_size: int,
+        num_workers: int = 16,
+        seed: int = 0,
+        image_size: int = IMAGE_SIZE,
+    ):
+        root = Path(data_root_dir)
+        self.train_loader = GrainImageLoader(
+            str(root / "train"),
+            total_batch_size,
+            train=True,
+            num_workers=num_workers,
+            seed=seed,
+            image_size=image_size,
+        )
+        self.test_loader = GrainImageLoader(
+            str(root / "val"),
+            total_batch_size,
+            train=False,
+            num_workers=num_workers,
+            seed=seed,
+            image_size=image_size,
+        )
+        if self.train_loader.source.classes != self.test_loader.source.classes:
+            raise ValueError(
+                "train/ and val/ class directories differ — label indices "
+                "would silently misalign between training and evaluation"
+            )
+        self.num_classes = self.train_loader.num_classes

@@ -1,0 +1,77 @@
+"""Deterministic synthetic datasets (same loader contract as CIFAR/ImageNet).
+
+No reference equivalent (the reference assumes downloaded/staged data,
+/root/reference/utils/dataset.py:121-149); this exists so every code path —
+tests, dry runs, benches — works in a zero-egress environment, and doubles
+as the input-pipeline-free configuration for pure compute benchmarking."""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .cifar import DeviceCifarLoader
+
+Batch = tuple[jax.Array, jax.Array]
+
+
+def synthetic_arrays(
+    num_samples: int,
+    image_size: int,
+    num_classes: int,
+    seed: int = 0,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Class-conditional uint8 images: each class gets a distinct mean so a
+    model can actually fit the data (integration tests check learning, not
+    just shapes)."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, num_classes, size=(num_samples,), dtype=np.int64)
+    means = rng.uniform(40.0, 215.0, size=(num_classes, 1, 1, 3))
+    noise = rng.normal(0.0, 25.0, size=(num_samples, image_size, image_size, 3))
+    images = np.clip(means[labels] + noise, 0, 255).astype(np.uint8)
+    return images, labels.astype(np.int32)
+
+
+class SyntheticLoaders:
+    """Train/test pair over synthetic data, device-resident (reuses the
+    CIFAR device loader so augmentation/shuffle semantics are identical)."""
+
+    def __init__(
+        self,
+        dataset_name: str,
+        batch_size: int,
+        image_size: int,
+        num_classes: int,
+        num_train: int = 2048,
+        num_test: int = 512,
+        seed: int = 0,
+    ):
+        self.num_classes = num_classes
+        train_x, train_y = synthetic_arrays(
+            num_train, image_size, num_classes, seed=seed
+        )
+        test_x, test_y = synthetic_arrays(
+            num_test, image_size, num_classes, seed=seed + 1
+        )
+        cifar_name = "CIFAR100" if dataset_name == "CIFAR100" else "CIFAR10"
+        self.train_loader = DeviceCifarLoader(
+            train_x,
+            train_y,
+            batch_size,
+            train=True,
+            dataset_name=cifar_name,
+            aug={"flip": True, "translate": 2},
+            altflip=True,
+            seed=seed,
+        )
+        self.test_loader = DeviceCifarLoader(
+            test_x,
+            test_y,
+            batch_size,
+            train=False,
+            dataset_name=cifar_name,
+            seed=seed + 1,
+        )
