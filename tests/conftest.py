@@ -22,6 +22,12 @@ import jax  # noqa: E402  (import after env setup)
 # config back to CPU so tests get the 8-device virtual mesh.
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: this container has ONE CPU core, and the
+# sharded-train-step compiles dominate test wall-clock; cache them across
+# pytest runs.
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
 import pytest  # noqa: E402
 
 

@@ -93,15 +93,18 @@ class TestDeviceLoader:
             assert labels.shape == (16,)
             assert labels.dtype == jnp.int32
 
-    def test_test_loader_keeps_last_partial_and_order(self):
+    def test_test_loader_pads_last_batch_and_keeps_order(self):
         loader = self._loader(train=False, n=70, bs=16)
         batches = list(loader)
         assert len(batches) == 5  # ceil(70/16)
-        assert batches[-1][0].shape[0] == 70 - 4 * 16
-        # no shuffle: labels concatenate back to the original order
+        # final batch padded to full size with sentinel label -1
+        assert batches[-1][0].shape[0] == 16
+        last_labels = np.asarray(batches[-1][1])
+        assert (last_labels[70 - 4 * 16 :] == -1).all()
+        # no shuffle: valid labels concatenate back to the original order
         x, y = synthetic_arrays(70, 8, 4, seed=0)
         got = np.concatenate([np.asarray(b[1]) for b in batches])
-        np.testing.assert_array_equal(got, y)
+        np.testing.assert_array_equal(got[got >= 0], y)
 
     def test_shuffle_differs_across_epochs_but_same_multiset(self):
         loader = self._loader(n=64, bs=64)
@@ -163,9 +166,11 @@ class TestGrainImageNet:
         assert imgs.shape == (4, 224, 224, 3)
         assert imgs.dtype == jnp.float32
         assert set(np.asarray(labels)) <= {0, 1}
-        # val: sequential, keeps partial batches
+        # val: sequential, final batch padded with label -1
         val_batches = list(loaders.test_loader)
-        total = sum(int(b[1].shape[0]) for b in val_batches)
+        for imgs, labels in val_batches:
+            assert imgs.shape[0] == 4
+        total = sum(int((np.asarray(b[1]) >= 0).sum()) for b in val_batches)
         assert total == 6
 
     def test_eval_center_crop_deterministic(self, fake_imagefolder):

@@ -1,0 +1,178 @@
+"""End-to-end harness/driver integration tests on the virtual 8-device CPU
+mesh (SURVEY.md §4: "integration tests driving 1-2 levels of a tiny model on
+synthetic data"). These exercise the FULL experiment loop: density ladder,
+prune between levels, rewind, level checkpoints, metrics CSVs, resume."""
+
+import jax
+import numpy as np
+import pandas as pd
+import pytest
+
+from turboprune_tpu.config.compose import compose
+from turboprune_tpu.driver import run, run_cyclic
+
+
+def _cfg(tmp_path, *extra):
+    return compose(
+        "cifar10_imp",
+        overrides=[
+            f"experiment_params.base_dir={tmp_path}",
+            "dataset_params.dataloader_type=synthetic",
+            "dataset_params.total_batch_size=16",
+            "dataset_params.synthetic_num_train=64",
+            "dataset_params.synthetic_num_test=32",
+            "experiment_params.epochs_per_level=2",
+            "experiment_params.max_steps_per_epoch=2",
+            "pruning_params.target_sparsity=0.36",
+            "model_params.model_name=resnet18",
+            *extra,
+        ],
+    )
+
+
+class TestIterativeIMP:
+    @pytest.fixture(scope="class")
+    def imp_run(self, tmp_path_factory):
+        tmp_path = tmp_path_factory.mktemp("imp")
+        cfg = _cfg(tmp_path)
+        expt_dir, summaries = run(cfg)
+        return cfg, expt_dir, summaries
+
+    def test_ladder_lengths_and_densities(self, imp_run):
+        _, _, summaries = imp_run
+        # 1.0, 0.8, 0.64 — stops at target density 0.64
+        assert len(summaries) == 3
+        np.testing.assert_allclose(
+            [s["density"] for s in summaries], [1.0, 0.8, 0.64], atol=1e-6
+        )
+        np.testing.assert_allclose(
+            [s["achieved_density"] for s in summaries],
+            [1.0, 0.8, 0.64],
+            atol=5e-4,
+        )
+
+    def test_artifacts_on_disk(self, imp_run):
+        from pathlib import Path
+
+        _, expt_dir, _ = imp_run
+        d = Path(expt_dir)
+        assert (d / "expt_config.yaml").exists()
+        for lvl in range(3):
+            assert (d / "checkpoints" / f"model_level_{lvl}").exists()
+            assert (
+                d / "metrics" / "level_wise_metrics" / f"level_{lvl}_metrics.csv"
+            ).exists()
+        assert (d / "checkpoints" / "model_init").exists()
+        assert (d / "artifacts" / "optimizer_init").exists()
+
+    def test_metrics_csv_contents(self, imp_run):
+        from pathlib import Path
+
+        cfg, expt_dir, _ = imp_run
+        d = Path(expt_dir)
+        lv = pd.read_csv(d / "metrics" / "level_wise_metrics" / "level_1_metrics.csv")
+        assert len(lv) == 2  # epochs_per_level
+        assert {"epoch", "train_loss", "train_acc", "test_loss", "test_acc",
+                "max_test_acc", "sparsity"} <= set(lv.columns)
+        assert (lv["sparsity"] > 19).all() and (lv["sparsity"] < 21).all()
+        summary_files = list((d / "metrics").glob("*_summary.csv"))
+        assert len(summary_files) == 1
+        summary = pd.read_csv(summary_files[0])
+        assert list(summary["level"]) == [0, 1, 2]
+
+    def test_resume_from_level(self, imp_run, tmp_path):
+        from pathlib import Path
+
+        cfg, expt_dir, summaries = imp_run
+        name = Path(expt_dir).name
+        cfg2 = _cfg(
+            Path(expt_dir).parent,
+            "experiment_params.resume_experiment=true",
+            f"experiment_params.resume_experiment_stuff.resume_expt_name={name}",
+            "experiment_params.resume_experiment_stuff.resume_level=2",
+        )
+        expt_dir2, summaries2 = run(cfg2)
+        assert expt_dir2 == expt_dir
+        assert len(summaries2) == 1
+        assert summaries2[0]["level"] == 2
+        np.testing.assert_allclose(summaries2[0]["density"], 0.64, atol=1e-6)
+
+
+class TestPruneAtInit:
+    def test_er_erk_single_level(self, tmp_path):
+        cfg = _cfg(
+            tmp_path,
+            "pruning_params.prune_method=er_erk",
+            "pruning_params.training_type=at_init",
+            "pruning_params.target_sparsity=0.5",
+        )
+        expt_dir, summaries = run(cfg)
+        assert len(summaries) == 1
+        # ERK clamps layer densities at 1 WITHOUT redistribution (reference
+        # pruning_utils.py:127), so on resnet18 the achieved density falls
+        # short of target; check against the allocation's own expectation
+        # (er_* additionally are Bernoulli draws — approximate).
+        import jax
+
+        from turboprune_tpu.models import create_model
+        from turboprune_tpu.ops import masking
+        from turboprune_tpu.pruning import erk_densities
+        from turboprune_tpu.train import create_optimizer, create_train_state
+
+        model = create_model("resnet18", 10, "CIFAR10")
+        tx = create_optimizer("SGD", 0.1)
+        st = create_train_state(model, tx, jax.random.PRNGKey(0), (1, 32, 32, 3))
+        alloc = erk_densities(st.masks, 0.5)
+        sizes = {
+            masking.path_name(p): m.size
+            for p, m in masking.mask_leaves_with_path(st.masks)
+        }
+        expected = sum(alloc[n] * sizes[n] for n in sizes) / sum(sizes.values())
+        assert abs(summaries[0]["achieved_density"] - expected) < 0.02
+
+    def test_snip_single_level(self, tmp_path):
+        cfg = _cfg(
+            tmp_path,
+            "pruning_params.prune_method=snip",
+            "pruning_params.training_type=at_init",
+            "pruning_params.target_sparsity=0.5",
+        )
+        _, summaries = run(cfg)
+        assert len(summaries) == 1
+        assert abs(summaries[0]["achieved_density"] - 0.5) < 5e-3
+
+
+class TestWeightRewinding:
+    def test_wr_trains_with_rewind_epoch(self, tmp_path):
+        from pathlib import Path
+
+        cfg = _cfg(
+            tmp_path,
+            "pruning_params.training_type=wr",
+            "pruning_params.rewind_epoch=0",
+            "pruning_params.target_sparsity=0.2",
+        )
+        expt_dir, summaries = run(cfg)
+        d = Path(expt_dir)
+        assert (d / "checkpoints" / "model_rewind").exists()
+        assert (d / "artifacts" / "optimizer_rewind").exists()
+        assert len(summaries) == 2  # 1.0, 0.8
+
+
+class TestCyclic:
+    def test_two_cycles_constant(self, tmp_path):
+        from pathlib import Path
+
+        cfg = _cfg(
+            tmp_path,
+            "cyclic_training.num_cycles=2",
+            "cyclic_training.strategy=constant",
+            "pruning_params.target_sparsity=0.2",
+        )
+        expt_dir, summaries = run_cyclic(cfg)
+        assert len(summaries) == 2
+        lv = pd.read_csv(
+            Path(expt_dir) / "metrics" / "level_wise_metrics" / "level_0_metrics.csv"
+        )
+        assert "cycle" in lv.columns
+        assert set(lv["cycle"]) == {0, 1}

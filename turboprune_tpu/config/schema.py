@@ -71,6 +71,9 @@ class DatasetConfig:
     # Image geometry; defaults filled per dataset_name in validate().
     image_size: int = 0
     num_classes: int = 0
+    # Synthetic-loader sizes (dataloader_type=synthetic only).
+    synthetic_num_train: int = 2048
+    synthetic_num_test: int = 512
 
     def validate(self) -> None:
         _check_choice("dataset_params.dataset_name", self.dataset_name, DATASETS)
@@ -79,6 +82,15 @@ class DatasetConfig:
         )
         if self.total_batch_size <= 0:
             raise ConfigError("total_batch_size must be positive")
+        if self.dataloader_type == "synthetic":
+            if self.synthetic_num_train < self.total_batch_size:
+                raise ConfigError(
+                    f"synthetic_num_train={self.synthetic_num_train} < "
+                    f"total_batch_size={self.total_batch_size}: the train "
+                    "loader would yield zero (drop_last) batches"
+                )
+            if self.synthetic_num_test < 1:
+                raise ConfigError("synthetic_num_test must be >= 1")
         if self.image_size == 0:
             self.image_size = 224 if self.dataset_name == "ImageNet" else 32
         if self.num_classes == 0:
@@ -145,6 +157,8 @@ class ExperimentConfig:
     max_steps_per_epoch: int = 0
     log_every_steps: int = 50
     use_wandb: bool = False
+    # When set, write a jax.profiler trace of level-0 epoch-1 here.
+    profile_dir: str = ""
 
     def validate(self) -> None:
         _check_choice(
@@ -263,9 +277,11 @@ _NESTED = {
 
 def _resolve_dataclass(ftype) -> Optional[type]:
     name = ftype if isinstance(ftype, str) else getattr(ftype, "__name__", str(ftype))
-    for key, cls in _NESTED.items():
+    # Longest key first: "ExperimentConfig" is a substring of
+    # "ResumeExperimentConfig" and must not shadow it.
+    for key in sorted(_NESTED, key=len, reverse=True):
         if key in str(name):
-            return cls
+            return _NESTED[key]
     return None
 
 

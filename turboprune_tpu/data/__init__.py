@@ -49,6 +49,8 @@ def create_loaders(cfg) -> Any:
             batch_size=dp.total_batch_size,
             image_size=dp.image_size,
             num_classes=dp.num_classes,
+            num_train=dp.synthetic_num_train,
+            num_test=dp.synthetic_num_test,
             seed=seed,
         )
     if dp.dataloader_type == "device":

@@ -35,6 +35,7 @@ from .augment import (
     normalize_uint8,
     pad_reflect,
 )
+from .padding import pad_eval_batch
 
 Batch = tuple[jax.Array, jax.Array]
 
@@ -200,7 +201,12 @@ class DeviceCifarLoader:
         for i in range(len(self)):
             lo = i * self.batch_size
             hi = min(lo + self.batch_size, n)
-            yield images[lo:hi], labels[lo:hi]
+            if hi - lo < self.batch_size:
+                # Final eval batch: pad to full size, sentinel label -1
+                # (masked by the eval step — see data/padding.py).
+                yield pad_eval_batch(images[lo:hi], labels[lo:hi], self.batch_size)
+            else:
+                yield images[lo:hi], labels[lo:hi]
 
 
 class CifarLoaders:

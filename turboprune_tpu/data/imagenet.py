@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .augment import IMAGENET_MEAN, IMAGENET_STD
+from .padding import pad_eval_batch
 
 try:  # grain is present in the standard image; gate anyway.
     import grain.python as grain
@@ -197,6 +198,10 @@ class GrainImageLoader:
         ) // self._shard_count
 
     def __len__(self) -> int:
+        """Train: batches per epoch window (= floor(shard/bs), exactly what
+        one epoch yields). Eval: the GLOBAL batch count — identical on every
+        host (largest shard, ceil), so lockstep SPMD eval steps line up;
+        smaller shards pad (label -1)."""
         n = self._shard_samples
         return n // self.batch_size if self.train else -(-n // self.batch_size)
 
@@ -229,17 +234,37 @@ class GrainImageLoader:
         """Host-side uint8 batches for one epoch.
 
         Train: ONE persistent DataLoader over an endless seeded stream
-        (grain reshuffles per pass); an epoch is the next ``len(self)``
-        batches — decode workers are spawned once for the whole run instead
-        of per epoch. Eval: a fresh single-pass sequential loader each call
-        so partial-batch/epoch alignment stays exact."""
+        (grain reshuffles every pass) — decode workers are spawned once for
+        the whole run. An epoch is a fixed window of exactly ``len(self)``
+        whole batches off that stream; since a shuffle pass is len(self) +
+        remainder/bs batches, the epoch/pass boundary drifts by the
+        sub-batch remainder per pass. No sample is dropped or duplicated
+        within a pass — "epoch" is an accounting window, not a shuffle
+        boundary (the harness consumes exactly len(loader) batches, so a
+        variable count would get truncated and silently drop data). Eval: a
+        fresh single-pass sequential loader, padded so EVERY host yields
+        exactly len(self) identically-shaped batches (multi-host lockstep,
+        see data/padding.py)."""
         if self.train:
             if self._stream is None:
                 self._stream = iter(self._make_loader(num_epochs=None))
             for _ in range(len(self)):
                 yield next(self._stream)
         else:
-            yield from self._make_loader(num_epochs=1)
+            count = 0
+            empty_shape = (0, self.image_size, self.image_size, 3)
+            for images, labels in self._make_loader(num_epochs=1):
+                yield pad_eval_batch(images, labels, self.batch_size)
+                count += 1
+            # Hosts whose shard is smaller than the largest emit all-pad
+            # batches until the global count — keeping collectives lockstep.
+            while count < len(self):
+                yield pad_eval_batch(
+                    np.zeros(empty_shape, np.uint8),
+                    np.zeros((0,), np.int32),
+                    self.batch_size,
+                )
+                count += 1
 
     def __iter__(self) -> Iterator[tuple[jax.Array, jax.Array]]:
         """Yield device-resident (normalized images, labels), keeping

@@ -1,0 +1,30 @@
+"""The eval-batch padding contract, in one place.
+
+Eval loaders pad partial batches to the full batch size with zero images
+and sentinel label -1; ``make_eval_step`` masks sentinel rows out of every
+metric. One shape per eval stream means a single compiled executable and
+identical lockstep collective counts on every host (train/steps.py
+docstring). Works on numpy or jax arrays (returns the same family)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+PAD_LABEL = -1
+
+
+def pad_eval_batch(images, labels, batch_size: int):
+    """Pad (images, labels) up to ``batch_size`` rows; no-op when full."""
+    pad = batch_size - images.shape[0]
+    if pad <= 0:
+        return images, labels
+    xp = np if isinstance(images, np.ndarray) else jnp
+    return (
+        xp.concatenate(
+            [images, xp.zeros((pad,) + images.shape[1:], images.dtype)]
+        ),
+        xp.concatenate(
+            [labels, xp.full((pad,), PAD_LABEL, labels.dtype)]
+        ),
+    )

@@ -23,13 +23,18 @@ def synthetic_arrays(
     image_size: int,
     num_classes: int,
     seed: int = 0,
+    class_seed: int = 12345,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Class-conditional uint8 images: each class gets a distinct mean so a
     model can actually fit the data (integration tests check learning, not
-    just shapes)."""
+    just shapes). The class means are drawn from ``class_seed`` ONLY —
+    train/test splits (different ``seed``) share the same class structure,
+    otherwise eval would be structurally random."""
+    means = np.random.default_rng(class_seed).uniform(
+        40.0, 215.0, size=(num_classes, 1, 1, 3)
+    )
     rng = np.random.default_rng(seed)
     labels = rng.integers(0, num_classes, size=(num_samples,), dtype=np.int64)
-    means = rng.uniform(40.0, 215.0, size=(num_classes, 1, 1, 3))
     noise = rng.normal(0.0, 25.0, size=(num_samples, image_size, image_size, 3))
     images = np.clip(means[labels] + noise, 0, 255).astype(np.uint8)
     return images, labels.astype(np.int32)

@@ -1,0 +1,152 @@
+"""The outer pruning-level loop (reference experiment drivers:
+/root/reference/run_experiment.py:22-126,
+run_cyclic_training_experiment.py:22-129).
+
+Control relationship preserved from the reference (SURVEY.md §1): the driver
+owns the LEVEL loop (density ladder, prune between levels, rewind, level
+checkpoints); the harness owns the epoch loop. What changes on TPU: pruning
+runs REPLICATED on every host from replicated state + a shared PRNG key —
+deterministic by construction — instead of the reference's rank-0 prune +
+DDP-construction broadcast (run_experiment.py:95-113); a post-prune
+fingerprint check asserts cross-host agreement (the reference's dormant
+check_model_equality, distributed_utils.py:31-60, made real).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Type
+
+import jax
+
+from .config.schema import MainConfig
+from .harness import CyclicPruningHarness, PruningHarness
+from .ops import masking
+from .parallel import broadcast_object, check_state_equality, is_primary
+from .pruning import generate_densities, prune_the_model
+from .utils import (
+    gen_expt_dir,
+    resume_experiment,
+    reset_weights,
+    save_config,
+    set_seed,
+)
+
+
+def _first_train_batch(harness):
+    for batch in harness.loaders.train_loader:
+        return batch
+    raise RuntimeError("empty train loader")
+
+
+def prune_level(harness, density: float, level: int) -> None:
+    """Prune the harness state to ``density`` and apply rewind semantics
+    (reference run_experiment.py:95-105 + reset_weights)."""
+    cfg = harness.cfg
+    method = cfg.pruning_params.prune_method
+    # Same key on every host => identical Bernoulli/normal draws (SURVEY.md
+    # §7 "Replicated pruning determinism").
+    rng = jax.random.fold_in(
+        jax.random.PRNGKey(cfg.experiment_params.seed), level
+    )
+    batch = None
+    if method in ("snip", "synflow"):
+        batch = _first_train_batch(harness)
+
+    state = harness.state
+    before = masking.overall_sparsity(state.masks)
+    masks = prune_the_model(
+        method,
+        harness.model,
+        {"params": state.params, "batch_stats": state.batch_stats}
+        if state.batch_stats
+        else {"params": state.params},
+        state.masks,
+        density,
+        rng,
+        batch=batch,
+    )
+    state = state.replace(masks=masks)
+    harness.state = state
+    after = masking.overall_sparsity(state.masks)
+    if is_primary():
+        print(
+            f"[prune] level {level}: {method} to density {density:.4f} "
+            f"(sparsity {before:.2f}% -> {after:.2f}%)",
+            flush=True,
+        )
+    # Rewind AFTER pruning: masks survive, weights roll back per
+    # training_type (custom_models.py:112-146 semantics).
+    harness.state = reset_weights(
+        cfg.pruning_params.training_type, harness.state, harness.ckpts
+    )
+    if jax.process_count() > 1:
+        check_state_equality(
+            {"params": harness.state.params, "masks": harness.state.masks}
+        )
+
+
+def run(cfg: MainConfig, harness_cls: Optional[Type[PruningHarness]] = None):
+    """Run the full experiment; returns (expt_dir, per-level summaries)."""
+    harness_cls = harness_cls or PruningHarness
+    ep = cfg.experiment_params
+    set_seed(ep.seed)
+
+    # Experiment dir decided on the primary host, broadcast as strings
+    # (reference broadcast_object of (prefix, expt_dir),
+    # run_experiment.py:54-72).
+    start_level = 0
+    if ep.resume_experiment:
+        prefix, expt_dir, start_level = resume_experiment(cfg)
+    elif is_primary():
+        prefix, expt_dir = gen_expt_dir(cfg)
+    else:
+        prefix, expt_dir = "", ""
+    if jax.process_count() > 1:
+        prefix, expt_dir, start_level = broadcast_object(
+            (prefix, expt_dir, start_level)
+        )
+    if is_primary():
+        save_config(expt_dir, cfg)
+
+    harness = harness_cls(cfg, (prefix, expt_dir))
+
+    pp = cfg.pruning_params
+    densities = generate_densities(
+        pp.prune_method, pp.target_sparsity, pp.prune_rate
+    )
+    if start_level:
+        if not harness.ckpts.has_level(start_level - 1):
+            raise FileNotFoundError(
+                f"resume_level={start_level} needs checkpoint "
+                f"model_level_{start_level - 1}"
+            )
+        restored = harness.ckpts.load_level(start_level - 1, harness.state)
+        harness.state = harness.state.replace(**restored)
+
+    summaries = []
+    for level in range(start_level, len(densities)):
+        density = densities[level]
+        if level == 0:
+            if pp.training_type == "at_init":
+                # PaI: prune the untrained network before any training
+                # (run_experiment.py:86-91). model_init is saved after, so
+                # it carries the pruned-at-init weights.
+                prune_level(harness, density, level)
+        else:
+            restored = harness.ckpts.load_level(level - 1, harness.state)
+            harness.state = harness.state.replace(**restored)
+            prune_level(harness, density, level)
+
+        summary = harness.train_one_level(ep.epochs_per_level, level)
+        # Orbax saves are multi-host coordinated — EVERY host participates
+        # (primary writes metadata, all hosts write their shards).
+        harness.ckpts.save_level(level, harness.state)
+        achieved = masking.overall_density(harness.state.masks)
+        summary["achieved_density"] = achieved
+        summaries.append(summary)
+    harness.wandb.finish()
+    return expt_dir, summaries
+
+
+def run_cyclic(cfg: MainConfig):
+    return run(cfg, harness_cls=CyclicPruningHarness)

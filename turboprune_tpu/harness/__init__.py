@@ -1,0 +1,7 @@
+"""Training harnesses (reference layer:
+/root/reference/harness_definitions/)."""
+
+from .cyclic_harness import CyclicPruningHarness
+from .pruning_harness import PruningHarness
+
+__all__ = ["PruningHarness", "CyclicPruningHarness"]

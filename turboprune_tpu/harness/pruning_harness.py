@@ -1,0 +1,284 @@
+"""PruningHarness — the training runtime.
+
+Rebuilds the reference harness stack (BaseHarness + PruningHarness,
+/root/reference/harness_definitions/base_harness.py:32-305,
+standard_pruning_harness.py:25-275) as one class around a jitted SPMD step:
+
+  - model / loaders / mesh built from config (reference _create_model /
+    _setup_dataloaders, standard_pruning_harness.py:128-157)
+  - ``train_one_level(epochs_per_level, level)`` owns the inner loop:
+    per-level optimizer + schedule re-init, level-0 init/rewind artifact
+    saves, per-epoch train + test, CSV/rich metric logging
+    (standard_pruning_harness.py:159-269)
+  - the hot loop is ONE compiled program per step: forward (masked weights),
+    backward, psum over the data mesh axis, optimizer update — where the
+    reference had DDP allreduce + autocast + host-side scheduler.step()
+    (base_harness.py:115-134,178-188)
+
+Metric sums stay on device during an epoch (loss*n / correct / n) and are
+pulled once at epoch end — the reference pays a host sync every step for
+wandb lr logging (base_harness.py:129-130); here async dispatch runs free.
+
+No per-level recompiles: the step function is cached by (total_steps) —
+same epoch budget every level means the level-1 compile is reused for all
+subsequent levels (SURVEY.md §7 "Recompile hazards").
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..config.schema import MainConfig
+from ..data import create_loaders
+from ..models import create_model
+from ..ops import masking
+from ..parallel import (
+    create_mesh,
+    make_sharded_eval_step,
+    make_sharded_train_step,
+    replicate,
+    shard_batch,
+)
+from ..train import (
+    TrainState,
+    create_optimizer,
+    create_schedule,
+    create_train_state,
+    eval_params,
+    make_eval_step,
+    make_train_step,
+)
+from ..parallel import is_primary
+from ..utils import (
+    MODEL_INIT,
+    MODEL_REWIND,
+    OPTIMIZER_INIT,
+    OPTIMIZER_REWIND,
+    ExperimentCheckpoints,
+    MetricsLogger,
+    display_training_info,
+)
+from ..utils.wandb_logging import WandbRun
+
+PRECISION_DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}
+
+
+class PruningHarness:
+    """Concrete trainer for one experiment (reference PruningHarness,
+    standard_pruning_harness.py:25)."""
+
+    def __init__(
+        self,
+        cfg: MainConfig,
+        expt_dir: tuple[str, str],
+        loaders: Optional[Any] = None,
+        state: Optional[TrainState] = None,
+    ):
+        self.cfg = cfg
+        self.prefix, self.expt_dir = expt_dir
+        ep = cfg.experiment_params
+        self.compute_dtype = PRECISION_DTYPES[ep.training_precision]
+
+        self.model = create_model(
+            cfg.model_params.model_name,
+            num_classes=cfg.dataset_params.num_classes,
+            dataset_name=cfg.dataset_params.dataset_name,
+            compute_dtype=self.compute_dtype,
+        )
+        self.loaders = loaders if loaders is not None else create_loaders(cfg)
+        self.mesh = create_mesh(num_devices=ep.num_devices)
+        data_size = self.mesh.shape["data"]
+        per_host_batch = cfg.dataset_params.total_batch_size // max(
+            jax.process_count(), 1
+        )
+        if per_host_batch % (data_size // max(jax.process_count(), 1) or 1):
+            raise ValueError(
+                f"per-host batch {per_host_batch} not divisible by local "
+                f"data-axis size — adjust total_batch_size or num_devices"
+            )
+        self.ckpts = ExperimentCheckpoints(self.expt_dir)
+        self.metrics = MetricsLogger(self.expt_dir, self.prefix)
+        self.wandb = WandbRun(cfg, self.prefix, self.expt_dir)
+
+        self.steps_per_epoch = len(self.loaders.train_loader)
+        if ep.max_steps_per_epoch:
+            self.steps_per_epoch = min(self.steps_per_epoch, ep.max_steps_per_epoch)
+
+        # Built per level (fresh optimizer semantics); cached by total_steps
+        # so identical level budgets reuse one executable.
+        self._step_cache: dict[int, tuple] = {}
+        self.tx = None
+        self.schedule = None
+
+        if state is None:
+            input_shape = (
+                1,
+                cfg.dataset_params.image_size,
+                cfg.dataset_params.image_size,
+                3,
+            )
+            # tx is rebuilt per level; init with a placeholder SGD so the
+            # opt_state pytree has the final structure.
+            tx0, _ = self._build_tx(epochs=ep.epochs_per_level)
+            state = create_train_state(
+                self.model,
+                tx0,
+                jax.random.PRNGKey(ep.seed),
+                input_shape,
+            )
+        self.state = replicate(state, self.mesh)
+
+        self._eval_step = make_sharded_eval_step(
+            make_eval_step(self.model), self.mesh
+        )
+
+    # ------------------------------------------------------------------ tx
+    def _build_tx(self, epochs: int):
+        op = self.cfg.optimizer_params
+        schedule = create_schedule(
+            op.scheduler_type,
+            base_lr=op.lr,
+            epochs=epochs,
+            steps_per_epoch=self.steps_per_epoch,
+            warmup_fraction=op.warmup_fraction,
+        )
+        tx = create_optimizer(
+            op.optimizer_name,
+            schedule,
+            momentum=op.momentum,
+            weight_decay=op.weight_decay,
+        )
+        return tx, schedule
+
+    def setup_level(self, epochs: int) -> None:
+        """Fresh optimizer + schedule for a level/cycle (reference
+        _setup_optimizer/_setup_scheduler per level,
+        standard_pruning_harness.py:174-175). Reuses the compiled step when
+        the epoch budget (=> schedule constants) is unchanged."""
+        total_steps = epochs * self.steps_per_epoch
+        if total_steps not in self._step_cache:
+            tx, schedule = self._build_tx(epochs)
+            step = make_sharded_train_step(
+                make_train_step(self.model, tx, schedule), self.mesh
+            )
+            self._step_cache[total_steps] = (tx, schedule, step)
+        self.tx, self.schedule, self._train_step = self._step_cache[total_steps]
+        self.state = replicate(
+            self.state.replace(
+                step=jnp.zeros((), jnp.int32), opt_state=self.tx.init(self.state.params)
+            ),
+            self.mesh,
+        )
+
+    # --------------------------------------------------------------- loops
+    def train_epoch(self) -> dict:
+        """One pass over the train loader (reference train_epoch,
+        base_harness.py:151-202). Returns host-side epoch means."""
+        sums = None
+        t0 = time.perf_counter()
+        for i, batch in enumerate(self.loaders.train_loader):
+            if i >= self.steps_per_epoch:
+                break
+            batch = shard_batch(batch, self.mesh)
+            self.state, m = self._train_step(self.state, batch)
+            m = {k: v for k, v in m.items() if k != "lr"}
+            sums = m if sums is None else jax.tree.map(jnp.add, sums, m)
+        if sums is None:
+            raise RuntimeError(
+                "train loader yielded no batches — dataset smaller than "
+                "total_batch_size with drop_last?"
+            )
+        sums = jax.device_get(sums)
+        wall = time.perf_counter() - t0
+        n = float(sums["count"])
+        return {
+            "train_loss": float(sums["loss_sum"]) / n,
+            "train_acc": 100.0 * float(sums["correct"]) / n,
+            "epoch_seconds": wall,
+            "samples_per_sec": n / wall,
+        }
+
+    def evaluate(self) -> dict:
+        """Full test pass (reference test, base_harness.py:204-245). For
+        schedule-free optimizers this evaluates the averaged weights."""
+        ev_state = self.state
+        if self.cfg.optimizer_params.optimizer_name == "ScheduleFreeSGD":
+            ev_state = ev_state.replace(
+                params=eval_params(ev_state.opt_state, ev_state.params)
+            )
+        sums = None
+        for batch in self.loaders.test_loader:
+            batch = shard_batch(batch, self.mesh)
+            m = self._eval_step(ev_state, batch)
+            sums = m if sums is None else jax.tree.map(jnp.add, sums, m)
+        if sums is None:
+            raise RuntimeError("test loader yielded no batches")
+        sums = jax.device_get(sums)
+        n = float(sums["count"])
+        return {
+            "test_loss": float(sums["loss_sum"]) / n,
+            "test_acc": 100.0 * float(sums["correct"]) / n,
+        }
+
+    # --------------------------------------------------------------- level
+    def train_one_level(self, epochs_per_level: int, level: int) -> dict:
+        """Train one sparsity level (reference train_one_level,
+        standard_pruning_harness.py:159-269)."""
+        self.setup_level(epochs_per_level)
+        density = masking.overall_density(self.state.masks)
+        display_training_info(self.cfg, level, density)
+
+        if level == 0:
+            # Level-0 artifacts: starting weights + optimizer (imp rewind
+            # target; standard_pruning_harness.py:190-199).
+            self.ckpts.save_model(MODEL_INIT, self.state)
+            self.ckpts.save_optimizer(OPTIMIZER_INIT, self.state.opt_state)
+
+        rewind_epoch = self.cfg.pruning_params.rewind_epoch
+        profile_dir = self.cfg.experiment_params.profile_dir
+        max_test_acc = 0.0
+        for epoch in range(epochs_per_level):
+            # Trace the second epoch of level 0 (first is compile-polluted).
+            tracing = bool(profile_dir) and level == 0 and epoch == 1
+            if tracing:
+                jax.profiler.start_trace(profile_dir)
+            row = {"level": level, "epoch": epoch}
+            row.update(self.train_epoch())
+            if tracing:
+                jax.profiler.stop_trace()
+            row.update(self.evaluate())
+            max_test_acc = max(max_test_acc, row["test_acc"])
+            row["max_test_acc"] = max_test_acc
+            row["sparsity"] = masking.overall_sparsity(self.state.masks)
+            self.metrics.log_epoch(row)
+            self.wandb.log(row)
+            self._log_console(row)
+
+            if level == 0 and rewind_epoch is not None and epoch == rewind_epoch:
+                # Weight-rewinding snapshot (standard_pruning_harness.py:
+                # 212-223).
+                self.ckpts.save_model(MODEL_REWIND, self.state)
+                self.ckpts.save_optimizer(OPTIMIZER_REWIND, self.state.opt_state)
+
+        return self.metrics.finish_level(
+            level,
+            {
+                "density": density,
+                "final_sparsity": masking.overall_sparsity(self.state.masks),
+            },
+        )
+
+    def _log_console(self, row: dict) -> None:
+        print(
+            f"[L{row['level']:>2} E{row['epoch']:>3}] "
+            f"train {row['train_loss']:.4f}/{row['train_acc']:5.2f}% "
+            f"test {row['test_loss']:.4f}/{row['test_acc']:5.2f}% "
+            f"(best {row['max_test_acc']:5.2f}%) "
+            f"sparsity {row['sparsity']:5.2f}% "
+            f"{row['samples_per_sec']:,.0f} img/s",
+            flush=True,
+        )

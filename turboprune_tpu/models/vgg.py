@@ -59,6 +59,13 @@ class VGG(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        if x.shape[1] < 32 or x.shape[2] < 32:
+            # 5 stride-2 maxpools: anything under 32px collapses to a
+            # zero-size tensor and the classifier silently emits bias-only
+            # logits. Fail loudly instead.
+            raise ValueError(
+                f"VGG needs inputs >= 32x32, got {x.shape[1]}x{x.shape[2]}"
+            )
         x = x.astype(self.dtype)
         conv_idx = 0
         for v in self.cfg:

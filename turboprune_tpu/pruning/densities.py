@@ -26,7 +26,9 @@ def generate_densities(
         densities = []
         current_density = 1.0 - current_sparsity
         target_density = 1.0 - target_sparsity
-        while current_density > target_density:
+        # Epsilon guards float dust: 0.8 * 0.8 = 0.6400000000000001 must not
+        # spawn a spurious extra level past an exact target of 0.64.
+        while current_density > target_density * (1.0 + 1e-9):
             densities.append(current_density)
             current_density *= 1.0 - prune_rate
         densities.append(current_density)

@@ -39,17 +39,20 @@ def cross_entropy_sum(logits: jax.Array, labels: jax.Array) -> jax.Array:
 
 def _forward_train(model, params, masks, batch_stats, images, rng):
     variables = {"params": apply_masks(params, masks)}
-    mutable = []
     if batch_stats:
         variables["batch_stats"] = batch_stats
-        mutable = ["batch_stats"]
-    out = model.apply(
-        variables, images, train=True, mutable=mutable, rngs={"dropout": rng}
-    )
-    if mutable:
-        logits, new_model_state = out
+        logits, new_model_state = model.apply(
+            variables,
+            images,
+            train=True,
+            mutable=["batch_stats"],
+            rngs={"dropout": rng},
+        )
         return logits, new_model_state.get("batch_stats", {})
-    return out, batch_stats
+    # No mutable collections (plain VGG, ViT): mutable=[] would make flax
+    # return a (logits, state) tuple — don't pass it at all.
+    logits = model.apply(variables, images, train=True, rngs={"dropout": rng})
+    return logits, batch_stats
 
 
 def make_train_step(
@@ -99,6 +102,12 @@ def make_train_step(
 def make_eval_step(model) -> Callable[[TrainState, Batch], dict]:
     """Pure eval step (reference test_step, base_harness.py:136-149).
 
+    Rows with label < 0 are PADDING and excluded from every metric: eval
+    loaders pad their final batch to the full batch size with label -1 so
+    all eval batches share one shape (single compiled executable, and every
+    host issues the same number of lockstep collective steps in multi-host
+    SPMD — a partial last batch would otherwise deadlock or recompile).
+
     For schedule-free optimizers evaluate with the averaged weights by
     passing ``state.replace(params=optim.eval_params(opt_state, params))``."""
 
@@ -108,12 +117,15 @@ def make_eval_step(model) -> Callable[[TrainState, Batch], dict]:
         if state.batch_stats:
             variables["batch_stats"] = state.batch_stats
         logits = model.apply(variables, images, train=False)
-        n = jnp.asarray(labels.shape[0], jnp.float32)
-        correct = jnp.sum(jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
+        valid = labels >= 0
+        safe_labels = jnp.maximum(labels, 0)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        per_row = -jnp.take_along_axis(logp, safe_labels[:, None], axis=1)[:, 0]
+        hit = jnp.argmax(logits, axis=-1) == safe_labels
         return {
-            "loss_sum": cross_entropy_sum(logits, labels),
-            "correct": correct,
-            "count": n,
+            "loss_sum": jnp.sum(jnp.where(valid, per_row, 0.0)),
+            "correct": jnp.sum(valid & hit).astype(jnp.float32),
+            "count": jnp.sum(valid).astype(jnp.float32),
         }
 
     return eval_step

@@ -1,0 +1,40 @@
+"""Experiment management + checkpointing (reference layer:
+/root/reference/utils/harness_utils.py + torch.save plumbing)."""
+
+from .checkpoint import (
+    MODEL_INIT,
+    MODEL_REWIND,
+    OPTIMIZER_INIT,
+    OPTIMIZER_REWIND,
+    ExperimentCheckpoints,
+    reset_weights,
+    restore_pytree,
+    save_pytree,
+)
+from .experiment import (
+    MetricsLogger,
+    display_training_info,
+    expt_prefix,
+    gen_expt_dir,
+    resume_experiment,
+    save_config,
+    set_seed,
+)
+
+__all__ = [
+    "ExperimentCheckpoints",
+    "reset_weights",
+    "save_pytree",
+    "restore_pytree",
+    "MODEL_INIT",
+    "MODEL_REWIND",
+    "OPTIMIZER_INIT",
+    "OPTIMIZER_REWIND",
+    "MetricsLogger",
+    "gen_expt_dir",
+    "resume_experiment",
+    "expt_prefix",
+    "save_config",
+    "set_seed",
+    "display_training_info",
+]

@@ -1,0 +1,144 @@
+"""Orbax checkpoints with the reference's artifact roles.
+
+The reference persists five artifact roles with torch.save
+(/root/reference/run_experiment.py:82-123,
+standard_pruning_harness.py:190-223, harness_utils.py:354-365):
+
+  checkpoints/model_init          level-0 starting weights (imp rewind target)
+  checkpoints/model_rewind        weights at rewind_epoch of level 0 (wr target)
+  artifacts/optimizer_init        optimizer state at level 0 start
+  artifacts/optimizer_rewind      optimizer state at rewind_epoch
+  checkpoints/model_level_{L}     end-of-level weights (next level's input)
+
+Here a "model" checkpoint is the ``{params, masks, batch_stats}`` pytree
+(the reference's state_dict carries mask buffers and BN running stats the
+same way) and an "optimizer" checkpoint is the optax ``opt_state`` pytree.
+Rewind semantics (reference PruneModel.reset_weights,
+custom_models.py:112-146): imp -> restore params+batch_stats from init,
+wr -> from rewind, lrr / at_init -> keep trained weights; masks are NEVER
+restored — the freshly pruned masks always survive a rewind.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+PyTree = Any
+
+MODEL_INIT = "model_init"
+MODEL_REWIND = "model_rewind"
+OPTIMIZER_INIT = "optimizer_init"
+OPTIMIZER_REWIND = "optimizer_rewind"
+
+_LEVEL_RE = re.compile(r"^model_level_(\d+)$")
+
+
+def save_pytree(path: str | Path, tree: PyTree) -> None:
+    """Atomic directory-style save (overwrites an existing checkpoint)."""
+    path = Path(path).resolve()
+    ckptr = ocp.StandardCheckpointer()
+    if path.exists():
+        import shutil
+
+        shutil.rmtree(path)
+    ckptr.save(path, tree)
+    ckptr.wait_until_finished()
+
+
+def restore_pytree(path: str | Path, like: Optional[PyTree] = None) -> PyTree:
+    """Restore; pass ``like`` (a matching concrete/abstract pytree) to get
+    exact container types back (optax namedtuples, custom nodes)."""
+    path = Path(path).resolve()
+    ckptr = ocp.StandardCheckpointer()
+    if like is None:
+        return ckptr.restore(path)
+    abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, like)
+    return ckptr.restore(path, abstract)
+
+
+class ExperimentCheckpoints:
+    """Role-addressed checkpoints under an experiment directory (the
+    reference's checkpoints/ + artifacts/ split, harness_utils.py:90-93)."""
+
+    def __init__(self, expt_dir: str | Path):
+        self.expt_dir = Path(expt_dir)
+        self.checkpoints_dir = self.expt_dir / "checkpoints"
+        self.artifacts_dir = self.expt_dir / "artifacts"
+        self.checkpoints_dir.mkdir(parents=True, exist_ok=True)
+        self.artifacts_dir.mkdir(parents=True, exist_ok=True)
+
+    # --- path helpers -----------------------------------------------------
+    def model_path(self, role: str) -> Path:
+        return self.checkpoints_dir / role
+
+    def optimizer_path(self, role: str) -> Path:
+        return self.artifacts_dir / role
+
+    def level_path(self, level: int) -> Path:
+        return self.checkpoints_dir / f"model_level_{level}"
+
+    # --- model roles ------------------------------------------------------
+    def model_state(self, state) -> dict:
+        return {
+            "params": state.params,
+            "masks": state.masks,
+            "batch_stats": state.batch_stats,
+        }
+
+    def save_model(self, role: str, state) -> None:
+        save_pytree(self.model_path(role), self.model_state(state))
+
+    def load_model(self, role: str, like_state) -> dict:
+        return restore_pytree(self.model_path(role), self.model_state(like_state))
+
+    def save_level(self, level: int, state) -> None:
+        save_pytree(self.level_path(level), self.model_state(state))
+
+    def load_level(self, level: int, like_state) -> dict:
+        return restore_pytree(self.level_path(level), self.model_state(like_state))
+
+    def has_model(self, role: str) -> bool:
+        return self.model_path(role).exists()
+
+    def has_level(self, level: int) -> bool:
+        return self.level_path(level).exists()
+
+    def saved_levels(self) -> list[int]:
+        out = []
+        for p in self.checkpoints_dir.iterdir():
+            m = _LEVEL_RE.match(p.name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    # --- optimizer roles --------------------------------------------------
+    def save_optimizer(self, role: str, opt_state) -> None:
+        save_pytree(self.optimizer_path(role), opt_state)
+
+    def load_optimizer(self, role: str, like_opt_state):
+        return restore_pytree(self.optimizer_path(role), like_opt_state)
+
+
+def reset_weights(training_type: str, state, ckpts: ExperimentCheckpoints):
+    """Post-prune rewind (reference reset_weights semantics,
+    custom_models.py:112-146): restores params + batch_stats from the role'd
+    checkpoint, KEEPS the current (just-pruned) masks.
+
+      imp      -> model_init
+      wr       -> model_rewind
+      lrr      -> no-op (learning-rate rewinding keeps trained weights)
+      at_init  -> no-op (PaI never rewinds)
+    """
+    role = {"imp": MODEL_INIT, "wr": MODEL_REWIND}.get(training_type)
+    if role is None:
+        return state
+    restored = ckpts.load_model(role, state)
+    return state.replace(
+        params=restored["params"], batch_stats=restored["batch_stats"]
+    )

@@ -1,0 +1,44 @@
+"""Optional wandb channel (reference: rank-0 wandb.init + per-epoch metric
+logging, /root/reference/run_experiment.py:57-59,
+standard_pruning_harness.py:271-275). Degrades to a no-op when wandb is not
+installed or ``experiment_params.use_wandb`` is false — the environment this
+framework targets is often egress-free. The reference's per-STEP lr logging
+(base_harness.py:129-130) is deliberately dropped: it forces a host sync
+every step and the lr is a pure function of the step count anyway."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class WandbRun:
+    """No-op unless wandb imports AND use_wandb is set."""
+
+    def __init__(self, cfg, prefix: str, expt_dir: str):
+        import jax
+
+        self._run = None
+        if not cfg.experiment_params.use_wandb or jax.process_index() != 0:
+            return
+        try:
+            import wandb
+
+            from ..config.schema import config_to_dict
+
+            self._run = wandb.init(
+                project=cfg.experiment_params.wandb_project_name,
+                name=prefix,
+                config=config_to_dict(cfg),
+                dir=expt_dir,
+            )
+        except Exception as e:  # pragma: no cover
+            print(f"[wandb] disabled ({e})", flush=True)
+
+    def log(self, metrics: dict, step: Optional[int] = None) -> None:
+        if self._run is not None:
+            self._run.log(metrics, step=step)
+
+    def finish(self) -> None:
+        if self._run is not None:
+            self._run.finish()
+            self._run = None
