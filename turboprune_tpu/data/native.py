@@ -1,0 +1,272 @@
+"""Native packed-dataset loader (ctypes binding for native/tpkdata.cpp).
+
+The first-party replacement for the role FFCV plays in the reference
+(/root/reference/utils/dataset.py:347-430): a memory-mapped packed file
+(.tpk) holding either fixed-size raw uint8 samples (mode 0 — CIFAR-style)
+or JPEG blobs with an offset table (mode 1 — ImageNet-style), read by a C++
+library that does multithreaded decode, torchvision-policy
+RandomResizedCrop / ratio center-crop, bilinear resize, and hflip entirely
+outside Python. The grain pipeline (imagenet.py) remains the
+multi-process-worker option; this is the low-overhead single-process path —
+FFCV's actual architecture (compiled pipeline + os_cache mmap).
+
+Python owns: file writing (``write_tpk_raw`` / ``write_tpk_jpegs`` /
+``pack_imagefolder``), epoch shuffling, per-host sharding, and handing
+batches to the device.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+from pathlib import Path
+from typing import Iterator, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .padding import pad_eval_batch
+
+_MAGIC = 0x444B5054  # "TPKD"
+_HEADER = struct.Struct("<IIQIIII")  # magic, version, n, mode, h, w, c
+_NATIVE_DIR = Path(__file__).resolve().parents[2] / "native"
+_LIB_PATH = _NATIVE_DIR / "libtpkdata.so"
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def ensure_built() -> Path:
+    """Build libtpkdata.so on first use (make is idempotent)."""
+    if not _LIB_PATH.exists():
+        subprocess.run(
+            ["make", "-C", str(_NATIVE_DIR)], check=True, capture_output=True
+        )
+    return _LIB_PATH
+
+
+def _load_lib() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(str(ensure_built()))
+        lib.tpk_open.restype = ctypes.c_void_p
+        lib.tpk_open.argtypes = [ctypes.c_char_p]
+        lib.tpk_close.argtypes = [ctypes.c_void_p]
+        lib.tpk_num_samples.restype = ctypes.c_int64
+        lib.tpk_num_samples.argtypes = [ctypes.c_void_p]
+        for f in (lib.tpk_mode, lib.tpk_height, lib.tpk_width, lib.tpk_channels):
+            f.restype = ctypes.c_int32
+            f.argtypes = [ctypes.c_void_p]
+        lib.tpk_read_raw_batch.restype = ctypes.c_int
+        lib.tpk_read_raw_batch.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int,
+        ]
+        lib.tpk_decode_batch.restype = ctypes.c_int
+        lib.tpk_decode_batch.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_uint64,
+            ctypes.c_double,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int,
+        ]
+        _lib = lib
+    return _lib
+
+
+# --------------------------------------------------------------- writers
+def write_tpk_raw(path: str | Path, images: np.ndarray, labels: np.ndarray) -> Path:
+    """Fixed-size uint8 NHWC samples (mode 0)."""
+    images = np.ascontiguousarray(images, np.uint8)
+    labels = np.ascontiguousarray(labels, np.int32)
+    n, h, w, c = images.shape
+    path = Path(path)
+    with open(path, "wb") as f:
+        f.write(_HEADER.pack(_MAGIC, 1, n, 0, h, w, c))
+        f.write(labels.tobytes())
+        f.write(images.tobytes())
+    return path
+
+
+def write_tpk_jpegs(
+    path: str | Path, blobs: Sequence[bytes], labels: np.ndarray
+) -> Path:
+    """Variable-size JPEG blobs with an offset table (mode 1)."""
+    labels = np.ascontiguousarray(labels, np.int32)
+    n = len(blobs)
+    assert labels.shape == (n,)
+    offsets = np.zeros(n + 1, np.uint64)
+    offsets[1:] = np.cumsum([len(b) for b in blobs])
+    path = Path(path)
+    with open(path, "wb") as f:
+        f.write(_HEADER.pack(_MAGIC, 1, n, 1, 0, 0, 0))
+        f.write(labels.tobytes())
+        f.write(offsets.tobytes())
+        for b in blobs:
+            f.write(b)
+    return path
+
+
+def pack_imagefolder(split_dir: str | Path, out_path: str | Path) -> Path:
+    """Pack an ImageFolder split's JPEGs into a .tpk (the analog of FFCV's
+    dataset-writing step that produces .beton files)."""
+    from .imagenet import _index_image_folder
+
+    paths, labels, _classes = _index_image_folder(Path(split_dir))
+    blobs = []
+    for p in paths:
+        with open(p, "rb") as f:
+            blobs.append(f.read())
+    return write_tpk_jpegs(out_path, blobs, np.asarray(labels, np.int32))
+
+
+# ---------------------------------------------------------------- reader
+class TpkFile:
+    def __init__(self, path: str | Path):
+        self._lib = _load_lib()
+        self._handle = self._lib.tpk_open(str(path).encode())
+        if not self._handle:
+            raise OSError(f"cannot open tpk file: {path}")
+        self.num_samples = int(self._lib.tpk_num_samples(self._handle))
+        self.mode = int(self._lib.tpk_mode(self._handle))
+        self.height = int(self._lib.tpk_height(self._handle))
+        self.width = int(self._lib.tpk_width(self._handle))
+        self.channels = int(self._lib.tpk_channels(self._handle))
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.tpk_close(self._handle)
+            self._handle = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def read_raw(
+        self, indices: np.ndarray, nthreads: int = 4
+    ) -> tuple[np.ndarray, np.ndarray]:
+        indices = np.ascontiguousarray(indices, np.int64)
+        n = len(indices)
+        images = np.empty((n, self.height, self.width, self.channels), np.uint8)
+        labels = np.empty(n, np.int32)
+        rc = self._lib.tpk_read_raw_batch(
+            self._handle,
+            indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n,
+            images.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            nthreads,
+        )
+        if rc:
+            raise RuntimeError(f"tpk_read_raw_batch failed (rc={rc})")
+        return images, labels
+
+    def decode(
+        self,
+        indices: np.ndarray,
+        out_size: int,
+        train: bool,
+        seed: int = 0,
+        center_crop_ratio: float = 224 / 256,
+        nthreads: int = 4,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        indices = np.ascontiguousarray(indices, np.int64)
+        n = len(indices)
+        images = np.empty((n, out_size, out_size, 3), np.uint8)
+        labels = np.empty(n, np.int32)
+        rc = self._lib.tpk_decode_batch(
+            self._handle,
+            indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n,
+            out_size,
+            1 if train else 0,
+            ctypes.c_uint64(seed),
+            center_crop_ratio,
+            images.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            nthreads,
+        )
+        if rc:
+            raise RuntimeError(f"tpk_decode_batch failed (rc={rc})")
+        return images, labels
+
+
+class TpkImageLoader:
+    """Epoch iterator over a .tpk: native decode, per-host sharding, device
+    normalize — the FFCV ``Loader`` contract (dataset.py:409-430): train =
+    shuffled + drop_last, eval = sequential + keep last."""
+
+    def __init__(
+        self,
+        path: str | Path,
+        total_batch_size: int,
+        train: bool,
+        image_size: int = 224,
+        seed: int = 0,
+        nthreads: int = 0,
+    ):
+        self.file = TpkFile(path)
+        nproc = jax.process_count()
+        if total_batch_size % nproc:
+            raise ValueError("total_batch_size not divisible by process_count")
+        self.batch_size = total_batch_size // nproc
+        self.train = train
+        self.image_size = image_size
+        self.seed = seed
+        self.nthreads = nthreads or min(16, os.cpu_count() or 1)
+        self.epoch = 0
+        # Per-host contiguous shard (FFCV distributed=True analog).
+        n = self.file.num_samples
+        pid = jax.process_index()
+        if train:
+            per = n // nproc
+            self._shard = np.arange(pid * per, (pid + 1) * per, dtype=np.int64)
+        else:
+            self._shard = np.arange(pid, n, nproc, dtype=np.int64)
+
+    def __len__(self) -> int:
+        if self.train:
+            return len(self._shard) // self.batch_size
+        # GLOBAL eval batch count (largest shard, ceil) — identical on every
+        # host so lockstep SPMD eval steps line up; short shards pad.
+        nproc = jax.process_count()
+        max_shard = -(-self.file.num_samples // nproc)
+        return -(-max_shard // self.batch_size)
+
+    def __iter__(self) -> Iterator[tuple[jax.Array, jax.Array]]:
+        from .imagenet import _normalize_device
+
+        epoch = self.epoch
+        self.epoch += 1
+        order = self._shard
+        if self.train:
+            rng = np.random.default_rng(self.seed + epoch)
+            order = rng.permutation(order)
+        for b in range(len(self)):
+            idx = order[b * self.batch_size : (b + 1) * self.batch_size]
+            if self.file.mode == 1:
+                images, labels = self.file.decode(
+                    idx,
+                    self.image_size,
+                    self.train,
+                    seed=self.seed * 1_000_003 + epoch,
+                    nthreads=self.nthreads,
+                )
+            else:
+                images, labels = self.file.read_raw(idx, nthreads=self.nthreads)
+            if not self.train:
+                images, labels = pad_eval_batch(images, labels, self.batch_size)
+            yield _normalize_device(jnp.asarray(images)), jnp.asarray(labels)
