@@ -171,7 +171,9 @@ class DeviceCifarLoader:
     def num_samples(self) -> int:
         return int(self.labels.shape[0])
 
-    def __iter__(self) -> Iterator[Batch]:
+    def _epoch_data(self) -> Batch:
+        """Augmented + shuffled arrays for one epoch (advances epoch/PRNG
+        state)."""
         epoch = self.epoch
         self.epoch += 1
         self._key, k_aug, k_perm = jax.random.split(self._key, 3)
@@ -190,14 +192,33 @@ class DeviceCifarLoader:
         else:
             images = self._base
 
-        n = self.labels.shape[0]
         if self.shuffle:
+            n = self.labels.shape[0]
             perm = jax.random.permutation(k_perm, n)
             images = jnp.take(images, perm, axis=0)
             labels = jnp.take(self.labels, perm, axis=0)
         else:
             labels = self.labels
+        return images, labels
 
+    def epoch_arrays(self) -> Batch:
+        """The whole epoch stacked on a step axis: images [S, B, H, W, C],
+        labels [S, B] — input for the lax.scan epoch runner
+        (train/steps.py make_scan_epoch): one dispatch per EPOCH instead of
+        per step. Train-mode only (needs drop_last's uniform batches)."""
+        if not self.drop_last:
+            raise ValueError("epoch_arrays requires drop_last (train mode)")
+        images, labels = self._epoch_data()
+        s = len(self)
+        used = s * self.batch_size
+        return (
+            images[:used].reshape((s, self.batch_size) + images.shape[1:]),
+            labels[:used].reshape(s, self.batch_size),
+        )
+
+    def __iter__(self) -> Iterator[Batch]:
+        images, labels = self._epoch_data()
+        n = self.labels.shape[0]
         for i in range(len(self)):
             lo = i * self.batch_size
             hi = min(lo + self.batch_size, n)

@@ -43,6 +43,7 @@ from ..parallel import (
     replicate,
     shard_batch,
 )
+from ..parallel import epoch_sharding, make_sharded_scan_epoch
 from ..train import (
     TrainState,
     create_optimizer,
@@ -50,6 +51,7 @@ from ..train import (
     create_train_state,
     eval_params,
     make_eval_step,
+    make_scan_epoch,
     make_train_step,
 )
 from ..parallel import is_primary
@@ -162,11 +164,13 @@ class PruningHarness:
         total_steps = epochs * self.steps_per_epoch
         if total_steps not in self._step_cache:
             tx, schedule = self._build_tx(epochs)
-            step = make_sharded_train_step(
-                make_train_step(self.model, tx, schedule), self.mesh
-            )
-            self._step_cache[total_steps] = (tx, schedule, step)
-        self.tx, self.schedule, self._train_step = self._step_cache[total_steps]
+            raw_step = make_train_step(self.model, tx, schedule)
+            step = make_sharded_train_step(raw_step, self.mesh)
+            scan = make_sharded_scan_epoch(make_scan_epoch(raw_step), self.mesh)
+            self._step_cache[total_steps] = (tx, schedule, step, scan)
+        self.tx, self.schedule, self._train_step, self._scan_epoch = (
+            self._step_cache[total_steps]
+        )
         self.state = replicate(
             self.state.replace(
                 step=jnp.zeros((), jnp.int32), opt_state=self.tx.init(self.state.params)
@@ -177,7 +181,32 @@ class PruningHarness:
     # --------------------------------------------------------------- loops
     def train_epoch(self) -> dict:
         """One pass over the train loader (reference train_epoch,
-        base_harness.py:151-202). Returns host-side epoch means."""
+        base_harness.py:151-202). Returns host-side epoch means.
+
+        Fast path: device-resident loaders expose ``epoch_arrays`` and the
+        whole epoch runs as ONE lax.scan program (make_scan_epoch) — no
+        per-step host dispatch at all. Streaming loaders (grain/tpk) take
+        the per-batch path."""
+        if (
+            hasattr(self.loaders.train_loader, "epoch_arrays")
+            and not self.cfg.experiment_params.max_steps_per_epoch
+        ):
+            t0 = time.perf_counter()
+            batches = jax.device_put(
+                self.loaders.train_loader.epoch_arrays(),
+                epoch_sharding(self.mesh),
+            )
+            self.state, sums = self._scan_epoch(self.state, batches)
+            sums = jax.device_get(sums)
+            wall = time.perf_counter() - t0
+            n = float(sums["count"])
+            return {
+                "train_loss": float(sums["loss_sum"]) / n,
+                "train_acc": 100.0 * float(sums["correct"]) / n,
+                "epoch_seconds": wall,
+                "samples_per_sec": n / wall,
+            }
+
         sums = None
         t0 = time.perf_counter()
         for i, batch in enumerate(self.loaders.train_loader):

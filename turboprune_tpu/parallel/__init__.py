@@ -5,7 +5,9 @@ from .mesh import (
     MODEL_AXIS,
     batch_sharding,
     create_mesh,
+    epoch_sharding,
     make_sharded_eval_step,
+    make_sharded_scan_epoch,
     make_sharded_train_step,
     replicate,
     replicated,
@@ -29,6 +31,8 @@ __all__ = [
     "replicated",
     "replicate",
     "shard_batch",
+    "epoch_sharding",
+    "make_sharded_scan_epoch",
     "make_sharded_train_step",
     "make_sharded_eval_step",
     "initialize_distributed",

@@ -85,3 +85,23 @@ def make_sharded_eval_step(eval_step: Callable, mesh: Mesh) -> Callable:
         in_shardings=(replicated(mesh), batch_sharding(mesh)),
         out_shardings=replicated(mesh),
     )
+
+
+def epoch_sharding(mesh: Mesh) -> NamedSharding:
+    """Stacked-epoch tensors [steps, batch, ...]: batch axis (dim 1) sharded
+    over data, step axis replicated."""
+    return NamedSharding(mesh, P(None, DATA_AXIS))
+
+
+def make_sharded_scan_epoch(
+    scan_epoch: Callable, mesh: Mesh, donate_state: bool = True
+) -> Callable:
+    """jit the lax.scan epoch runner (train/steps.py make_scan_epoch): the
+    whole epoch executes as ONE XLA program with the per-step psum still
+    inserted by the partitioner — zero host dispatches in the hot loop."""
+    return jax.jit(
+        scan_epoch,
+        in_shardings=(replicated(mesh), epoch_sharding(mesh)),
+        out_shardings=(replicated(mesh), replicated(mesh)),
+        donate_argnums=(0,) if donate_state else (),
+    )
