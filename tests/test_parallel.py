@@ -40,6 +40,22 @@ def test_mesh_shape(devices):
     assert mesh2.shape["data"] == len(devices) // 2
 
 
+def test_create_mesh_raises_on_insufficient_devices(devices):
+    """Requesting more devices than exist must fail loudly, not silently
+    truncate (suspected cause of the r01 dryrun hang — VERDICT.md)."""
+    with pytest.raises(ValueError, match="refusing"):
+        create_mesh(num_devices=len(devices) + 1)
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_subprocess():
+    """The driver-facing dry run must pass regardless of this process's
+    backend: it spawns a subprocess pinned to a virtual 8-device CPU mesh."""
+    import __graft_entry__
+
+    __graft_entry__.dryrun_multichip(8)  # raises on failure
+
+
 def test_batch_is_sharded_over_data_axis(setup):
     _, _, _, batch = setup
     mesh = create_mesh()

@@ -87,8 +87,22 @@ def compose_dict(
     if not self_merged:
         merged = _deep_merge(merged, top)
 
+    # Hydra semantics: group selection happens before value overrides,
+    # regardless of argv order — a dotted override must never be clobbered
+    # by a group override that appears later on the command line.
+    groups: list[tuple[list[str], object]] = []
+    dotted: list[tuple[list[str], object]] = []
     for item in overrides:
         keys, value = _parse_override(item)
+        if len(keys) == 1 and isinstance(value, str) and (root / keys[0]).is_dir():
+            groups.append((keys, value))
+        else:
+            dotted.append((keys, value))
+    for keys, value in groups:
+        # Group override (``dataset_params=dp_synthetic_cifar10``):
+        # replace the whole group with the named option file.
+        merged[keys[0]] = _load_yaml(root / keys[0] / f"{value}.yaml")
+    for keys, value in dotted:
         _set_dotted(merged, keys, value)
     return merged
 

@@ -234,11 +234,26 @@ def _from_dict(cls, data: dict):
             continue
         value = data[name]
         ftype = f.type
-        if isinstance(value, dict):
-            # nested dataclass (handles Optional[Nested] too)
-            nested = _resolve_dataclass(ftype)
-            if nested is not None:
+        nested = _resolve_dataclass(ftype)
+        if nested is not None:
+            if isinstance(value, dict):
                 value = _from_dict(nested, value)
+            elif value is None and "Optional" not in str(ftype):
+                raise ConfigError(
+                    f"{name} is a required config group "
+                    f"({nested.__name__}) and cannot be null"
+                )
+            elif value is not None:
+                hint = (
+                    f" — for a config-group override use '{name}=<option>' "
+                    f"where <option> is a yaml under conf/{name}/"
+                    if cls is MainConfig
+                    else ""
+                )
+                raise ConfigError(
+                    f"{name} must be a mapping ({nested.__name__}), "
+                    f"got {value!r}{hint}"
+                )
         kwargs[name] = _coerce(name, ftype, value)
     return cls(**kwargs)
 

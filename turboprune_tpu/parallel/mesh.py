@@ -35,6 +35,12 @@ def create_mesh(
     the same mesh."""
     devs = list(devices) if devices is not None else jax.devices()
     if num_devices:
+        if num_devices < 0 or len(devs) < num_devices:
+            raise ValueError(
+                f"create_mesh(num_devices={num_devices}): only {len(devs)} "
+                f"device(s) visible on backend {jax.default_backend()!r} — "
+                "refusing to silently under-provision the mesh"
+            )
         devs = devs[:num_devices]
     n = len(devs)
     if n % model_parallelism:
