@@ -67,3 +67,78 @@ def test_imagenet_defaults():
     cfg = compose("imagenet_imp")
     assert cfg.dataset_params.num_classes == 1000
     assert cfg.dataset_params.image_size == 224
+
+
+def test_rewind_epoch_must_fit_level_budget():
+    # Out-of-range rewind would silently never save model_rewind, then
+    # crash at the level-1 rewind after burning level 0's compute.
+    with pytest.raises(ConfigError, match="outside level 0"):
+        compose(
+            "cifar10_imp",
+            overrides=[
+                "pruning_params.training_type=wr",
+                "pruning_params.rewind_epoch=150",
+                "experiment_params.epochs_per_level=150",
+            ],
+        )
+    # Cyclic: the budget is cycle 0's epochs, not the whole level.
+    with pytest.raises(ConfigError, match="outside level 0"):
+        compose(
+            "cifar10_imp",
+            overrides=[
+                "pruning_params.training_type=wr",
+                "pruning_params.rewind_epoch=100",
+                "experiment_params.epochs_per_level=160",
+                "cyclic_training.num_cycles=4",
+                "cyclic_training.strategy=constant",
+            ],
+        )
+    # In range passes.
+    cfg = compose(
+        "cifar10_imp",
+        overrides=[
+            "pruning_params.training_type=wr",
+            "pruning_params.rewind_epoch=5",
+        ],
+    )
+    assert cfg.pruning_params.rewind_epoch == 5
+
+
+def test_rewind_optimizer_requires_wr():
+    with pytest.raises(ConfigError, match="only meaningful for wr"):
+        compose(
+            "cifar10_imp", overrides=["pruning_params.rewind_optimizer=true"]
+        )
+    cfg = compose(
+        "cifar10_imp",
+        overrides=[
+            "pruning_params.training_type=wr",
+            "pruning_params.rewind_epoch=5",
+            "pruning_params.rewind_optimizer=true",
+        ],
+    )
+    assert cfg.pruning_params.rewind_optimizer is True
+
+
+def test_group_override_and_dotted_order_independent():
+    a = compose(
+        "cifar10_imp",
+        overrides=[
+            "dataset_params.num_workers=4",
+            "dataset_params=dp_synthetic_cifar10",
+        ],
+    )
+    b = compose(
+        "cifar10_imp",
+        overrides=[
+            "dataset_params=dp_synthetic_cifar10",
+            "dataset_params.num_workers=4",
+        ],
+    )
+    assert a.dataset_params.num_workers == b.dataset_params.num_workers == 4
+    assert a.dataset_params.dataloader_type == "synthetic"
+
+
+def test_required_group_cannot_be_null():
+    with pytest.raises(ConfigError, match="required config group"):
+        compose("cifar10_imp", overrides=["dataset_params=null"])

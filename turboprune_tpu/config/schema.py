@@ -121,6 +121,11 @@ class PruneConfig:
     target_sparsity: float = 0.999
     training_type: str = "imp"
     rewind_epoch: Optional[int] = None
+    # WR only: also restore the optimizer state (momentum buffers) captured
+    # at rewind_epoch when rewinding weights. The reference wrote this
+    # artifact but never loaded it (dead reset_optimizer,
+    # harness_utils.py:24-46); default False preserves that behavior.
+    rewind_optimizer: bool = False
 
     def validate(self) -> None:
         _check_choice("pruning_params.prune_method", self.prune_method, PRUNE_METHODS)
@@ -133,6 +138,10 @@ class PruneConfig:
             raise ConfigError("prune_rate must be in (0, 1) for iterative pruning")
         if self.training_type == "wr" and self.rewind_epoch is None:
             raise ConfigError("training_type=wr requires rewind_epoch")
+        if self.rewind_epoch is not None and self.rewind_epoch < 0:
+            raise ConfigError("rewind_epoch must be >= 0")
+        if self.rewind_optimizer and self.training_type != "wr":
+            raise ConfigError("rewind_optimizer is only meaningful for wr")
 
 
 @dataclass
@@ -215,6 +224,25 @@ class MainConfig:
             sub = getattr(self, f.name)
             if sub is not None and hasattr(sub, "validate"):
                 sub.validate()
+        # Cross-group: the rewind snapshot is taken at epoch == rewind_epoch
+        # of level 0 (cycle 0 for cyclic) — an out-of-range value would
+        # silently never save model_rewind and crash at the level-1 rewind
+        # AFTER burning all of level 0's compute.
+        rewind_epoch = self.pruning_params.rewind_epoch
+        if rewind_epoch is not None:
+            from ..pruning.densities import generate_cyclical_schedule
+
+            budget = generate_cyclical_schedule(
+                self.experiment_params.epochs_per_level,
+                self.cyclic_training.num_cycles,
+                self.cyclic_training.strategy,
+            )[0]
+            if rewind_epoch >= budget:
+                raise ConfigError(
+                    f"rewind_epoch={rewind_epoch} is outside level 0's "
+                    f"first-cycle epoch budget ({budget}): the rewind "
+                    "snapshot would never be saved"
+                )
         return self
 
 

@@ -120,7 +120,13 @@ class DeviceCifarLoader:
 
     Mirrors the reference CifarLoader's contract (dataset.py:101-256):
     train => shuffle + drop_last + aug {flip, translate=2, altflip};
-    test => in-order, no aug, keep last partial batch."""
+    test => in-order, no aug, keep last partial batch.
+
+    ``batch_scope = "global"``: the whole dataset is resident on every host
+    (CIFAR is single-host in the reference too, run_experiment.py:24-42), so
+    each yielded batch is the full global batch."""
+
+    batch_scope = "global"
 
     def __init__(
         self,

@@ -162,7 +162,11 @@ class GrainImageLoader:
 
     Per-host batch = total_batch_size / process_count (the reference divides
     by world size, dataset.py:411); sharding is ``ShardByJaxProcess`` so each
-    host reads a disjoint slice — FFCV's ``distributed=True`` equivalent."""
+    host reads a disjoint slice — FFCV's ``distributed=True`` equivalent.
+    ``batch_scope = "host"``: each yielded batch is THIS host's slice; the
+    harness assembles the global array (parallel.assemble_batch)."""
+
+    batch_scope = "host"
 
     def __init__(
         self,
@@ -190,12 +194,19 @@ class GrainImageLoader:
         self.prefetch = prefetch
         self.image_size = image_size
         self.epoch = 0
-        self._stream: Optional[Iterator] = None  # persistent train iterator
+        self._stream: Optional[Iterator] = None  # persistent sample/batch stream
         shard = grain.ShardByJaxProcess(drop_remainder=train)
         self._shard_count = shard.shard_count
         self._shard_samples = len(self.source) // self._shard_count if train else (
             len(self.source) + self._shard_count - 1
         ) // self._shard_count
+        # THIS host's shard size (grain splits contiguously, remainder to the
+        # first shards — sharding.even_split); for eval it bounds the sample
+        # window taken off the persistent stream each epoch.
+        n, c = len(self.source), self._shard_count
+        self._local_shard_samples = (
+            n // c if train else n // c + (1 if shard.shard_index < n % c else 0)
+        )
 
     def __len__(self) -> int:
         """Train: batches per epoch window (= floor(shard/bs), exactly what
@@ -217,12 +228,18 @@ class GrainImageLoader:
             num_epochs=num_epochs,
             seed=self.seed,
         )
+        # Train batches in the pipeline; eval batches on the host (its
+        # endless sample stream has no epoch boundary for grain.Batch to
+        # respect — a partial final batch must not swallow the next pass).
         ops = [
             _TrainTransform(self.image_size)
             if self.train
             else _EvalTransform(self.image_size),
-            grain.Batch(batch_size=self.batch_size, drop_remainder=self.train),
         ]
+        if self.train:
+            ops.append(
+                grain.Batch(batch_size=self.batch_size, drop_remainder=True)
+            )
         return grain.DataLoader(
             data_source=self.source,
             sampler=sampler,
@@ -241,23 +258,46 @@ class GrainImageLoader:
         sub-batch remainder per pass. No sample is dropped or duplicated
         within a pass — "epoch" is an accounting window, not a shuffle
         boundary (the harness consumes exactly len(loader) batches, so a
-        variable count would get truncated and silently drop data). Eval: a
-        fresh single-pass sequential loader, padded so EVERY host yields
-        exactly len(self) identically-shaped batches (multi-host lockstep,
-        see data/padding.py)."""
+        variable count would get truncated and silently drop data).
+
+        Eval: ONE persistent endless SEQUENTIAL sample stream per split —
+        the sequential order repeats identically every pass, so a window of
+        exactly ``_local_shard_samples`` samples IS one full pass over this
+        host's shard, and decode workers survive across epochs (a fresh
+        single-pass loader would respawn ``num_workers`` processes after
+        every training epoch). Batches are assembled host-side and padded so
+        EVERY host yields exactly ``len(self)`` identically-shaped batches
+        (multi-host lockstep, see data/padding.py)."""
         if self.train:
             if self._stream is None:
                 self._stream = iter(self._make_loader(num_epochs=None))
             for _ in range(len(self)):
                 yield next(self._stream)
         else:
+            if self._stream is None:
+                self._stream = iter(self._make_loader(num_epochs=None))
             count = 0
-            empty_shape = (0, self.image_size, self.image_size, 3)
-            for images, labels in self._make_loader(num_epochs=1):
-                yield pad_eval_batch(images, labels, self.batch_size)
+            imgs: list = []
+            labels: list = []
+            for _ in range(self._local_shard_samples):
+                img, lbl = next(self._stream)
+                imgs.append(img)
+                labels.append(lbl)
+                if len(imgs) == self.batch_size:
+                    yield pad_eval_batch(
+                        np.stack(imgs), np.asarray(labels, np.int32),
+                        self.batch_size,
+                    )
+                    imgs, labels = [], []
+                    count += 1
+            if imgs:
+                yield pad_eval_batch(
+                    np.stack(imgs), np.asarray(labels, np.int32), self.batch_size
+                )
                 count += 1
             # Hosts whose shard is smaller than the largest emit all-pad
             # batches until the global count — keeping collectives lockstep.
+            empty_shape = (0, self.image_size, self.image_size, 3)
             while count < len(self):
                 yield pad_eval_batch(
                     np.zeros(empty_shape, np.uint8),

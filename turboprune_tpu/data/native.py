@@ -207,7 +207,10 @@ class TpkFile:
 class TpkImageLoader:
     """Epoch iterator over a .tpk: native decode, per-host sharding, device
     normalize — the FFCV ``Loader`` contract (dataset.py:409-430): train =
-    shuffled + drop_last, eval = sequential + keep last."""
+    shuffled + drop_last, eval = sequential + keep last.
+    ``batch_scope = "host"``: yields THIS host's slice of the global batch."""
+
+    batch_scope = "host"
 
     def __init__(
         self,

@@ -138,8 +138,8 @@ def run(cfg: MainConfig, harness_cls: Optional[Type[PruningHarness]] = None):
             prune_level(harness, density, level)
 
         summary = harness.train_one_level(ep.epochs_per_level, level)
-        # Orbax saves are multi-host coordinated — EVERY host participates
-        # (primary writes metadata, all hosts write their shards).
+        # Saves are primary-only with a cross-host barrier — state is
+        # replicated, so host 0 holds everything (utils/checkpoint.py).
         harness.ckpts.save_level(level, harness.state)
         achieved = masking.overall_density(harness.state.masks)
         summary["achieved_density"] = achieved

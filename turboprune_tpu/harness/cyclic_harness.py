@@ -45,6 +45,8 @@ class CyclicPruningHarness(PruningHarness):
             # Fresh optimizer + schedule per cycle: the LR re-warms from the
             # schedule's start (cyclic_harness.py:180-194).
             self.setup_level(epochs)
+            if cycle == 0:
+                self.maybe_rewind_optimizer(level)
             for epoch in range(epochs):
                 row = {"level": level, "cycle": cycle, "epoch": epoch}
                 row.update(self.train_epoch())

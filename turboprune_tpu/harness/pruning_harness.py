@@ -38,12 +38,13 @@ from ..models import create_model
 from ..ops import masking
 from ..parallel import (
     create_mesh,
+    epoch_sharding,
     make_sharded_eval_step,
+    make_sharded_scan_epoch,
     make_sharded_train_step,
     replicate,
     shard_batch,
 )
-from ..parallel import epoch_sharding, make_sharded_scan_epoch
 from ..train import (
     TrainState,
     create_optimizer,
@@ -54,7 +55,6 @@ from ..train import (
     make_scan_epoch,
     make_train_step,
 )
-from ..parallel import is_primary
 from ..utils import (
     MODEL_INIT,
     MODEL_REWIND,
@@ -178,6 +178,16 @@ class PruningHarness:
             self.mesh,
         )
 
+    def maybe_rewind_optimizer(self, level: int) -> None:
+        """WR + ``rewind_optimizer``: restore the momentum buffers captured
+        at rewind_epoch (the reference's unrealized intent — dead
+        reset_optimizer, harness_utils.py:24-46). The schedule still restarts
+        from step 0 (per-level fresh scheduler, like the reference)."""
+        pp = self.cfg.pruning_params
+        if level > 0 and pp.training_type == "wr" and pp.rewind_optimizer:
+            opt = self.ckpts.load_optimizer(OPTIMIZER_REWIND, self.state.opt_state)
+            self.state = replicate(self.state.replace(opt_state=opt), self.mesh)
+
     # --------------------------------------------------------------- loops
     def train_epoch(self) -> dict:
         """One pass over the train loader (reference train_epoch,
@@ -258,6 +268,7 @@ class PruningHarness:
         """Train one sparsity level (reference train_one_level,
         standard_pruning_harness.py:159-269)."""
         self.setup_level(epochs_per_level)
+        self.maybe_rewind_optimizer(level)
         density = masking.overall_density(self.state.masks)
         display_training_info(self.cfg, level, density)
 

@@ -4,9 +4,20 @@ Rebuilds the architectures the reference gets from
 ``torchvision.models.resnet*`` (/root/reference/utils/custom_models.py:184)
 with the same CIFAR stem surgery: 3x3 stride-1 conv1, no maxpool, fresh fc
 (custom_models.py:197-215). NHWC layout and bf16-friendly compute for the
-TPU MXU; BatchNorm statistics are batch-local by default (the reference uses
-unsynced per-replica BN under DDP, SURVEY.md §7 hard parts — pass
-``bn_cross_replica_axis`` to opt into sync-BN under shard_map).
+TPU MXU.
+
+BatchNorm semantics under SPMD: batch statistics are computed over the
+GLOBAL batch. Under ``pjit`` the whole step is one program, so the BN
+mean/var reductions span the full data axis (XLA inserts the collectives) —
+asserted by tests/test_parallel.py::test_sharded_train_matches_single_device.
+This deliberately DIFFERS from the reference, which trains with per-replica
+unsynced BN under DDP (SURVEY.md §7): global-batch BN computes the exact
+statistics per-replica BN only approximates, and at the recipe's batch sizes
+(512 global / 64-per-replica-equivalent) published ResNet results show the
+two train to equivalent accuracy — while global stats remove the
+replica-count dependence of the reference's regularization noise.
+``bn_cross_replica_axis`` exists only for shard_map-style per-shard
+execution, where it restores cross-shard syncing.
 """
 
 from __future__ import annotations

@@ -51,7 +51,6 @@ class VGG(nn.Module):
     cfg: Sequence
     num_classes: int
     batch_norm: bool = True
-    cifar_head: bool = False
     dtype: Any = jnp.float32
     dropout_rate: float = 0.5
     bn_momentum: float = 0.9
@@ -100,10 +99,10 @@ class VGG(nn.Module):
 
 def _make(name: str, batch_norm: bool):
     def ctor(num_classes: int, cifar_stem: bool = False, **kw) -> VGG:
-        return VGG(
-            VGG_CFGS[name], num_classes, batch_norm=batch_norm,
-            cifar_head=cifar_stem, **kw,
-        )
+        # cifar_stem is accepted for ctor-signature uniformity with resnet;
+        # this VGG needs no surgery — adaptive_avg_pool handles 32px inputs.
+        del cifar_stem
+        return VGG(VGG_CFGS[name], num_classes, batch_norm=batch_norm, **kw)
 
     return ctor
 

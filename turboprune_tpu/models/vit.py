@@ -132,11 +132,10 @@ class VisionTransformer(nn.Module):
         if not self.distilled:
             return head(x[:, 0])
         head_dist = nn.Dense(self.num_classes, dtype=jnp.float32, name="head_dist")
-        out, out_dist = head(x[:, 0]), head_dist(x[:, 1])
-        if train:
-            # training returns both; the harness's CE uses their mean
-            return (out + out_dist) / 2.0
-        return (out + out_dist) / 2.0
+        # Mean of both heads, train and eval alike: without a teacher there
+        # is no distillation loss, so the dist token is just a second head
+        # (the reference's DeiT path was unreachable anyway, SURVEY.md §2.1).
+        return (head(x[:, 0]) + head_dist(x[:, 1])) / 2.0
 
 
 def _deit(embed_dim, depth, num_heads, distilled=False):

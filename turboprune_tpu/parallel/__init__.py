@@ -11,6 +11,7 @@ from .mesh import (
     make_sharded_train_step,
     replicate,
     replicated,
+    assemble_batch,
     shard_batch,
 )
 from .multihost import (
@@ -30,6 +31,7 @@ __all__ = [
     "batch_sharding",
     "replicated",
     "replicate",
+    "assemble_batch",
     "shard_batch",
     "epoch_sharding",
     "make_sharded_scan_epoch",

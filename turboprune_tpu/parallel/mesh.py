@@ -65,6 +65,30 @@ def shard_batch(batch: PyTree, mesh: Mesh) -> PyTree:
     return jax.device_put(batch, batch_sharding(mesh))
 
 
+def assemble_batch(batch: PyTree, mesh: Mesh, scope: str = "global") -> PyTree:
+    """Turn a loader batch into a GLOBAL data-sharded array.
+
+    The loader contract (data/__init__.py): loaders declare
+    ``batch_scope`` — "global" (every host holds the full batch: device
+    CIFAR, synthetic) or "host" (each host holds total/process_count rows:
+    grain/tpk ImageNet, FFCV's ``distributed=True`` equivalent,
+    /root/reference/utils/dataset.py:411).
+
+    Host-local batches are assembled with
+    ``jax.make_array_from_process_local_data`` — handing a host-local array
+    straight to a global sharding would scatter the wrong rows (or die on
+    divisibility) on >1 process.
+    """
+    sharding = batch_sharding(mesh)
+    if scope == "global" or jax.process_count() == 1:
+        return jax.device_put(batch, sharding)
+    if scope != "host":
+        raise ValueError(f"unknown batch scope {scope!r}")
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(sharding, x), batch
+    )
+
+
 def replicate(tree: PyTree, mesh: Mesh) -> PyTree:
     return jax.device_put(tree, replicated(mesh))
 

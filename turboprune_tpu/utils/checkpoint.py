@@ -27,6 +27,7 @@ from pathlib import Path
 from typing import Any, Optional
 
 import jax
+import numpy as np
 import orbax.checkpoint as ocp
 
 PyTree = Any
@@ -40,15 +41,36 @@ _LEVEL_RE = re.compile(r"^model_level_(\d+)$")
 
 
 def save_pytree(path: str | Path, tree: PyTree) -> None:
-    """Atomic directory-style save (overwrites an existing checkpoint)."""
-    path = Path(path).resolve()
-    ckptr = ocp.StandardCheckpointer()
-    if path.exists():
-        import shutil
+    """Atomic directory-style save (overwrites an existing checkpoint).
 
-        shutil.rmtree(path)
-    ckptr.save(path, tree)
-    ckptr.wait_until_finished()
+    Multi-host: PRIMARY-ONLY. Framework state is replicated across hosts
+    (params/masks/opt_state all live on every host — see parallel/mesh.py
+    ``replicated``), so host 0 materializes the tree as numpy and writes
+    alone; everyone else waits at a barrier. N hosts doing rmtree+save on a
+    shared filesystem would stomp one directory, and on local disks the
+    non-primary writes are wasted (the reference's torch.save is likewise
+    rank-0-only, standard_pruning_harness.py:190-199)."""
+    from ..parallel.multihost import is_primary, sync_hosts
+
+    path = Path(path).resolve()
+    if is_primary():
+        # device_get works per-host on replicated arrays; saving numpy keeps
+        # Orbax out of multihost-coordination mode (which would require every
+        # process to participate in the save).
+        host_tree = jax.tree.map(
+            lambda x: np.asarray(jax.device_get(x))
+            if isinstance(x, jax.Array)
+            else x,
+            tree,
+        )
+        ckptr = ocp.StandardCheckpointer()
+        if path.exists():
+            import shutil
+
+            shutil.rmtree(path)
+        ckptr.save(path, host_tree)
+        ckptr.wait_until_finished()
+    sync_hosts(f"save_pytree:{path.name}")
 
 
 def restore_pytree(path: str | Path, like: Optional[PyTree] = None) -> PyTree:
