@@ -142,3 +142,54 @@ def test_group_override_and_dotted_order_independent():
 def test_required_group_cannot_be_null():
     with pytest.raises(ConfigError, match="required config group"):
         compose("cifar10_imp", overrides=["dataset_params=null"])
+
+
+def test_group_override_keeps_primary_config_tweaks(tmp_path):
+    """A CLI group override substitutes which option file the defaults list
+    selects — composition still runs in defaults-list order, so a primary
+    yaml whose ``_self_`` comes AFTER the group keeps its direct tweaks
+    (Hydra reapplies primary-config values per defaults-list order)."""
+    (tmp_path / "dataset_params").mkdir()
+    (tmp_path / "dataset_params" / "opt_a.yaml").write_text(
+        "dataset_name: CIFAR10\ntotal_batch_size: 128\nnum_workers: 2\n"
+    )
+    (tmp_path / "dataset_params" / "opt_b.yaml").write_text(
+        "dataset_name: CIFAR100\ntotal_batch_size: 256\nnum_workers: 8\n"
+    )
+    (tmp_path / "main.yaml").write_text(
+        "defaults:\n"
+        "  - dataset_params: opt_a\n"
+        "  - _self_\n"
+        "dataset_params:\n"
+        "  total_batch_size: 999\n"
+    )
+    base = compose_dict("main", config_path=tmp_path)
+    assert base["dataset_params"]["total_batch_size"] == 999
+    over = compose_dict(
+        "main", overrides=["dataset_params=opt_b"], config_path=tmp_path
+    )
+    assert over["dataset_params"]["dataset_name"] == "CIFAR100"
+    assert over["dataset_params"]["num_workers"] == 8
+    # the primary config's direct tweak survives the group override
+    assert over["dataset_params"]["total_batch_size"] == 999
+
+    # With _self_ FIRST (this repo's conf/ style), the group option wins
+    # over primary values — including when chosen by a CLI group override.
+    (tmp_path / "main_self_first.yaml").write_text(
+        "defaults:\n"
+        "  - _self_\n"
+        "  - dataset_params: opt_a\n"
+        "dataset_params:\n"
+        "  total_batch_size: 999\n"
+    )
+    sf = compose_dict(
+        "main_self_first", overrides=["dataset_params=opt_b"], config_path=tmp_path
+    )
+    assert sf["dataset_params"]["total_batch_size"] == 256
+
+
+def test_fp16_precision_accepted():
+    cfg = compose(
+        "cifar10_imp", overrides=["experiment_params.training_precision=float16"]
+    )
+    assert cfg.experiment_params.training_precision == "float16"

@@ -159,6 +159,93 @@ class TestWeightRewinding:
         assert len(summaries) == 2  # 1.0, 0.8
 
 
+class TestOptimizerRewind:
+    def test_wr_rewind_restores_momentum_but_not_schedule_count(self, tmp_path):
+        """rewind_optimizer must restore the momentum trace captured at
+        rewind_epoch while the per-level LR schedule restarts at step 0 —
+        restoring ScaleByScheduleState.count would fast-forward the fresh
+        schedule to rewind_epoch's position (ADVICE r3)."""
+        import optax
+
+        from turboprune_tpu.harness import PruningHarness
+        from turboprune_tpu.utils import OPTIMIZER_REWIND, gen_expt_dir
+
+        cfg = _cfg(
+            tmp_path,
+            "pruning_params.training_type=wr",
+            "pruning_params.rewind_epoch=0",
+            "pruning_params.rewind_optimizer=true",
+        )
+        h = PruningHarness(cfg, gen_expt_dir(cfg))
+        h.setup_level(cfg.experiment_params.epochs_per_level)
+        h.train_epoch()  # advance: momentum warm, schedule count > 0
+        saved_count = int(optax.tree_utils.tree_get(h.state.opt_state, "count"))
+        assert saved_count > 0
+        saved_trace = jax.tree.map(
+            lambda x: np.asarray(jax.device_get(x)),
+            optax.tree_utils.tree_get(h.state.opt_state, "trace"),
+        )
+        h.ckpts.save_optimizer(OPTIMIZER_REWIND, h.state.opt_state)
+
+        h.setup_level(cfg.experiment_params.epochs_per_level)  # fresh level
+        assert int(optax.tree_utils.tree_get(h.state.opt_state, "count")) == 0
+        h.maybe_rewind_optimizer(level=1)
+        # momentum buffers came back ...
+        got_trace = optax.tree_utils.tree_get(h.state.opt_state, "trace")
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a), b),
+            got_trace,
+            saved_trace,
+        )
+        # ... but the schedule count did NOT
+        assert int(optax.tree_utils.tree_get(h.state.opt_state, "count")) == 0
+
+    def test_adamw_rewind_keeps_bias_correction_count(self, tmp_path):
+        """Only the SCHEDULE state resets on rewind: AdamW's
+        ScaleByAdamState.count drives bias correction for the restored
+        mu/nu moments and must be restored WITH them (code-review r4)."""
+        import optax
+
+        from turboprune_tpu.harness import PruningHarness
+        from turboprune_tpu.utils import OPTIMIZER_REWIND, gen_expt_dir
+
+        def states_of(tree, typ):
+            found = []
+
+            def walk(node):
+                if isinstance(node, typ):
+                    found.append(node)
+                    return
+                if isinstance(node, (tuple, list)):
+                    for c in node:
+                        walk(c)
+
+            walk(tree)
+            return found
+
+        cfg = _cfg(
+            tmp_path,
+            "optimizer_params.optimizer_name=AdamW",
+            "pruning_params.training_type=wr",
+            "pruning_params.rewind_epoch=0",
+            "pruning_params.rewind_optimizer=true",
+        )
+        h = PruningHarness(cfg, gen_expt_dir(cfg))
+        h.setup_level(cfg.experiment_params.epochs_per_level)
+        h.train_epoch()
+        (adam,) = states_of(h.state.opt_state, optax.ScaleByAdamState)
+        saved_adam_count = int(adam.count)
+        assert saved_adam_count > 0
+        h.ckpts.save_optimizer(OPTIMIZER_REWIND, h.state.opt_state)
+
+        h.setup_level(cfg.experiment_params.epochs_per_level)
+        h.maybe_rewind_optimizer(level=1)
+        (adam,) = states_of(h.state.opt_state, optax.ScaleByAdamState)
+        assert int(adam.count) == saved_adam_count  # bias correction intact
+        (sched,) = states_of(h.state.opt_state, optax.ScaleByScheduleState)
+        assert int(sched.count) == 0  # schedule restarts
+
+
 class TestCyclic:
     def test_two_cycles_constant(self, tmp_path):
         from pathlib import Path

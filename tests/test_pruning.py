@@ -81,8 +81,32 @@ def test_cyclic_schedule_budget():
         epochs = generate_cyclical_schedule(40, 5, strategy)
         assert len(epochs) == 5
         assert sum(epochs) <= 40, strategy
-        assert all(e >= 0 for e in epochs), strategy
+        assert all(e >= 1 for e in epochs), strategy
     assert generate_cyclical_schedule(40, 1, "constant") == [40]
+
+
+def test_cyclic_schedule_small_budget_never_zero_epochs():
+    """Int truncation used to emit 0-epoch cycles (silent no-op cycles in
+    the harness) — every cycle must get >= 1 epoch within budget."""
+    import pytest
+
+    for strategy in (
+        "linear_increase",
+        "linear_decrease",
+        "exponential_decrease",
+        "exponential_increase",
+        "cyclic_peak",
+        "alternating",
+        "plateau",
+        "constant",
+    ):
+        for budget, cycles in ((4, 4), (5, 4), (7, 6), (8, 3)):
+            epochs = generate_cyclical_schedule(budget, cycles, strategy)
+            assert len(epochs) == cycles, strategy
+            assert sum(epochs) <= budget, (strategy, budget, cycles, epochs)
+            assert all(e >= 1 for e in epochs), (strategy, budget, cycles, epochs)
+    with pytest.raises(ValueError, match="at least one epoch"):
+        generate_cyclical_schedule(3, 4, "constant")
 
 
 # ------------------------------------------------------------------- criteria

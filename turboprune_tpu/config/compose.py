@@ -70,15 +70,44 @@ def compose_dict(
     top = _load_yaml(root / f"{name}.yaml")
     defaults = top.pop("defaults", [])
 
+    # Hydra semantics: group selection happens before value overrides,
+    # regardless of argv order — a dotted override must never be clobbered
+    # by a group override that appears later on the command line.
+    group_overrides: dict[str, str] = {}
+    dotted: list[tuple[list[str], object]] = []
+    for item in overrides:
+        keys, value = _parse_override(item)
+        if len(keys) == 1 and isinstance(value, str) and (root / keys[0]).is_dir():
+            group_overrides[keys[0]] = value
+        else:
+            dotted.append((keys, value))
+
+    # A CLI group override substitutes WHICH option file the defaults list
+    # names for that group; composition still runs in defaults-list order,
+    # so values the primary config sets directly (its _self_ position) keep
+    # their Hydra precedence instead of being wholesale-discarded.
+    resolved: list = []
+    seen_groups = set()
+    for entry in defaults:
+        if entry == "_self_":
+            resolved.append(entry)
+            continue
+        if not isinstance(entry, dict) or len(entry) != 1:
+            raise ConfigError(f"defaults entry {entry!r} must be 'group: option'")
+        (group, option), = entry.items()
+        seen_groups.add(group)
+        resolved.append({group: group_overrides.get(group, option)})
+    for group, option in group_overrides.items():
+        if group not in seen_groups:  # group absent from defaults: append
+            resolved.append({group: option})
+
     merged: dict = {}
     self_merged = False
-    for entry in defaults:
+    for entry in resolved:
         if entry == "_self_":
             merged = _deep_merge(merged, top)
             self_merged = True
             continue
-        if not isinstance(entry, dict) or len(entry) != 1:
-            raise ConfigError(f"defaults entry {entry!r} must be 'group: option'")
         (group, option), = entry.items()
         if option is None:
             continue
@@ -87,21 +116,6 @@ def compose_dict(
     if not self_merged:
         merged = _deep_merge(merged, top)
 
-    # Hydra semantics: group selection happens before value overrides,
-    # regardless of argv order — a dotted override must never be clobbered
-    # by a group override that appears later on the command line.
-    groups: list[tuple[list[str], object]] = []
-    dotted: list[tuple[list[str], object]] = []
-    for item in overrides:
-        keys, value = _parse_override(item)
-        if len(keys) == 1 and isinstance(value, str) and (root / keys[0]).is_dir():
-            groups.append((keys, value))
-        else:
-            dotted.append((keys, value))
-    for keys, value in groups:
-        # Group override (``dataset_params=dp_synthetic_cifar10``):
-        # replace the whole group with the named option file.
-        merged[keys[0]] = _load_yaml(root / keys[0] / f"{value}.yaml")
     for keys, value in dotted:
         _set_dotted(merged, keys, value)
     return merged

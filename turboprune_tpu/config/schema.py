@@ -15,7 +15,7 @@ from typing import Any, Optional
 
 # Choice sets (reference: harness_params.py Literals).
 DATASETS = ("CIFAR10", "CIFAR100", "ImageNet")
-DATALOADER_TYPES = ("device", "grain", "synthetic")
+DATALOADER_TYPES = ("device", "grain", "tpk", "synthetic")
 MASK_LAYER_TYPES = ("ConvMask", "LinearMask")
 PRUNE_METHODS = (
     "er_erk",
@@ -28,7 +28,10 @@ PRUNE_METHODS = (
     "just dont",
 )
 TRAINING_TYPES = ("imp", "wr", "lrr", "at_init")
-PRECISIONS = ("bfloat16", "float32")
+# fp16 included for reference-parity (base_harness.py:92-101); on TPU
+# bfloat16 is the native fast dtype and the recommended default (fp16 has
+# no hardware advantage and a narrower exponent range).
+PRECISIONS = ("bfloat16", "float16", "float32")
 OPTIMIZERS = ("SGD", "AdamW", "ScheduleFreeSGD")
 SCHEDULERS = (
     "MultiStepLRWarmup",
@@ -74,6 +77,14 @@ class DatasetConfig:
     # Synthetic-loader sizes (dataloader_type=synthetic only).
     synthetic_num_train: int = 2048
     synthetic_num_test: int = 512
+    # Native packed-dataset loader (dataloader_type=tpk): .tpk file paths;
+    # empty = <data_root_dir>/{train,val}.tpk. With tpk_auto_pack, missing
+    # .tpk files are packed once from ImageFolder splits under data_root_dir
+    # (the analog of FFCV's .beton writing step).
+    tpk_train_path: str = ""
+    tpk_val_path: str = ""
+    tpk_auto_pack: bool = False
+    tpk_nthreads: int = 0  # 0 = min(16, cpu_count)
 
     def validate(self) -> None:
         _check_choice("dataset_params.dataset_name", self.dataset_name, DATASETS)

@@ -8,6 +8,9 @@ standard_pruning_harness.py:145-157):
   device    whole dataset in HBM, whole-epoch jitted augmentation (CIFAR)
   grain     multi-process host decode + per-host sharding + device prefetch
             (ImageNet; the FFCV replacement)
+  tpk       first-party native loader: mmap'd packed file + multithreaded
+            C++ decode/crop (native/tpkdata.cpp) — FFCV's actual
+            architecture (compiled pipeline + os_cache mmap)
   synthetic deterministic generated data (zero-egress tests/benches)
 
 All loaders share one contract: ``.train_loader`` / ``.test_loader``
@@ -71,6 +74,20 @@ def create_loaders(cfg) -> Any:
             num_workers=dp.num_workers,
             seed=seed,
             image_size=dp.image_size,
+        )
+    if dp.dataloader_type == "tpk":
+        from .native import TpkLoaders
+
+        return TpkLoaders(
+            data_root_dir=dp.data_root_dir,
+            total_batch_size=dp.total_batch_size,
+            num_classes=dp.num_classes,
+            image_size=dp.image_size,
+            seed=seed,
+            nthreads=dp.tpk_nthreads,
+            train_path=dp.tpk_train_path,
+            val_path=dp.tpk_val_path,
+            auto_pack=dp.tpk_auto_pack,
         )
     raise ValueError(f"Unknown dataloader_type: {dp.dataloader_type}")
 

@@ -17,6 +17,7 @@ from __future__ import annotations
 from typing import Optional, Type
 
 import jax
+import numpy as np
 
 from .config.schema import MainConfig
 from .harness import CyclicPruningHarness, PruningHarness
@@ -33,7 +34,25 @@ from .utils import (
 
 
 def _first_train_batch(harness):
-    for batch in harness.loaders.train_loader:
+    """One GLOBALLY-IDENTICAL scoring batch for data-driven criteria.
+
+    Host-scope loaders (grain/tpk) yield different rows on each process —
+    scoring SNIP on those would diverge the masks across hosts and trip the
+    post-prune fingerprint check. Allgather the per-host slices so every
+    host scores on the same full global batch (the reference sidesteps this
+    with rank-0 prune + DDP broadcast, run_experiment.py:95-113)."""
+    loader = harness.loaders.train_loader
+    for batch in loader:
+        if (
+            getattr(loader, "batch_scope", "global") == "host"
+            and jax.process_count() > 1
+        ):
+            from jax.experimental import multihost_utils
+
+            batch = jax.tree.map(
+                lambda x: np.asarray(jax.device_get(x)), batch
+            )
+            batch = multihost_utils.process_allgather(batch, tiled=True)
         return batch
     raise RuntimeError("empty train loader")
 

@@ -37,13 +37,13 @@ from ..data import create_loaders
 from ..models import create_model
 from ..ops import masking
 from ..parallel import (
+    assemble_batch,
     create_mesh,
     epoch_sharding,
     make_sharded_eval_step,
     make_sharded_scan_epoch,
     make_sharded_train_step,
     replicate,
-    shard_batch,
 )
 from ..train import (
     TrainState,
@@ -66,7 +66,11 @@ from ..utils import (
 )
 from ..utils.wandb_logging import WandbRun
 
-PRECISION_DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}
+PRECISION_DTYPES = {
+    "bfloat16": jnp.bfloat16,
+    "float16": jnp.float16,
+    "float32": jnp.float32,
+}
 
 
 class PruningHarness:
@@ -182,10 +186,22 @@ class PruningHarness:
         """WR + ``rewind_optimizer``: restore the momentum buffers captured
         at rewind_epoch (the reference's unrealized intent — dead
         reset_optimizer, harness_utils.py:24-46). The schedule still restarts
-        from step 0 (per-level fresh scheduler, like the reference)."""
+        from step 0 (per-level fresh scheduler, like the reference): the
+        restored ScaleByScheduleState (schedule position captured at
+        rewind_epoch) is swapped for the fresh level-start one so the
+        schedule is not fast-forwarded. ONLY the schedule state is reset —
+        e.g. AdamW's ScaleByAdamState.count drives bias correction for the
+        restored moments and must come back with them."""
+        import optax
+
         pp = self.cfg.pruning_params
         if level > 0 and pp.training_type == "wr" and pp.rewind_optimizer:
-            opt = self.ckpts.load_optimizer(OPTIMIZER_REWIND, self.state.opt_state)
+            fresh = self.state.opt_state
+            opt = self.ckpts.load_optimizer(OPTIMIZER_REWIND, fresh)
+            is_sched = lambda x: isinstance(x, optax.ScaleByScheduleState)
+            opt = jax.tree.map(
+                lambda r, f: f if is_sched(r) else r, opt, fresh, is_leaf=is_sched
+            )
             self.state = replicate(self.state.replace(opt_state=opt), self.mesh)
 
     # --------------------------------------------------------------- loops
@@ -219,10 +235,12 @@ class PruningHarness:
 
         sums = None
         t0 = time.perf_counter()
-        for i, batch in enumerate(self.loaders.train_loader):
+        train_loader = self.loaders.train_loader
+        train_scope = getattr(train_loader, "batch_scope", "global")
+        for i, batch in enumerate(train_loader):
             if i >= self.steps_per_epoch:
                 break
-            batch = shard_batch(batch, self.mesh)
+            batch = assemble_batch(batch, self.mesh, train_scope)
             self.state, m = self._train_step(self.state, batch)
             m = {k: v for k, v in m.items() if k != "lr"}
             sums = m if sums is None else jax.tree.map(jnp.add, sums, m)
@@ -250,8 +268,10 @@ class PruningHarness:
                 params=eval_params(ev_state.opt_state, ev_state.params)
             )
         sums = None
-        for batch in self.loaders.test_loader:
-            batch = shard_batch(batch, self.mesh)
+        test_loader = self.loaders.test_loader
+        test_scope = getattr(test_loader, "batch_scope", "global")
+        for batch in test_loader:
+            batch = assemble_batch(batch, self.mesh, test_scope)
             m = self._eval_step(ev_state, batch)
             sums = m if sums is None else jax.tree.map(jnp.add, sums, m)
         if sums is None:

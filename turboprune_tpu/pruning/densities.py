@@ -89,4 +89,17 @@ def generate_cyclical_schedule(
         # this, so no further correction is needed.
         scale = epochs_per_level / total
         epochs = [int(e * scale) for e in epochs]
+
+    # Int truncation can produce 0-epoch cycles (e.g. exponential_decrease
+    # with a small budget) — the harness would silently run no-op cycles.
+    # Every cycle trains at least 1 epoch; overflow is trimmed from the
+    # largest cycles, which terminates because budget >= num_cycles.
+    if epochs_per_level < num_cycles:
+        raise ValueError(
+            f"epochs_per_level={epochs_per_level} < num_cycles={num_cycles}: "
+            "cannot give every cycle at least one epoch"
+        )
+    epochs = [max(1, e) for e in epochs]
+    while sum(epochs) > epochs_per_level:
+        epochs[epochs.index(max(epochs))] -= 1
     return epochs

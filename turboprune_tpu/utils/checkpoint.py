@@ -40,6 +40,26 @@ OPTIMIZER_REWIND = "optimizer_rewind"
 _LEVEL_RE = re.compile(r"^model_level_(\d+)$")
 
 
+def _primary_only_checkpointer() -> ocp.StandardCheckpointer:
+    """A Checkpointer whose internal barriers involve ONLY process 0.
+
+    ocp.StandardCheckpointer.save() unconditionally runs
+    sync_global_processes barriers across every process in the world — so a
+    save called under ``if is_primary()`` would leave host 0 stuck in
+    Orbax's barrier while the other hosts wait at our own sync_hosts().
+    MultiprocessingOptions(active_processes={0}) tells Orbax only process 0
+    participates, making primary-only save safe."""
+    if jax.process_count() == 1:
+        return ocp.StandardCheckpointer()
+    return ocp.StandardCheckpointer(
+        multiprocessing_options=ocp.options.MultiprocessingOptions(
+            primary_host=0,
+            active_processes={0},
+            barrier_sync_key_prefix="tpk_primary_save",
+        )
+    )
+
+
 def save_pytree(path: str | Path, tree: PyTree) -> None:
     """Atomic directory-style save (overwrites an existing checkpoint).
 
@@ -47,23 +67,25 @@ def save_pytree(path: str | Path, tree: PyTree) -> None:
     (params/masks/opt_state all live on every host — see parallel/mesh.py
     ``replicated``), so host 0 materializes the tree as numpy and writes
     alone; everyone else waits at a barrier. N hosts doing rmtree+save on a
-    shared filesystem would stomp one directory, and on local disks the
-    non-primary writes are wasted (the reference's torch.save is likewise
-    rank-0-only, standard_pruning_harness.py:190-199)."""
+    shared filesystem would stomp one directory (the reference's torch.save
+    is likewise rank-0-only, standard_pruning_harness.py:190-199).
+
+    REQUIREMENT: on >1 process the experiment dir must be on storage every
+    host can read (NFS/GCS/localhost-shared disk) — restore_pytree is called
+    by ALL hosts (reset_weights / optimizer rewind / level resume)."""
     from ..parallel.multihost import is_primary, sync_hosts
 
     path = Path(path).resolve()
     if is_primary():
         # device_get works per-host on replicated arrays; saving numpy keeps
-        # Orbax out of multihost-coordination mode (which would require every
-        # process to participate in the save).
+        # the array leaves fully addressable for the single-process save.
         host_tree = jax.tree.map(
             lambda x: np.asarray(jax.device_get(x))
             if isinstance(x, jax.Array)
             else x,
             tree,
         )
-        ckptr = ocp.StandardCheckpointer()
+        ckptr = _primary_only_checkpointer()
         if path.exists():
             import shutil
 
