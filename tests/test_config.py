@@ -188,6 +188,22 @@ def test_group_override_keeps_primary_config_tweaks(tmp_path):
     assert sf["dataset_params"]["total_batch_size"] == 256
 
 
+def test_group_override_not_in_defaults_rejected(tmp_path):
+    """Overriding a group the defaults list doesn't select errors (Hydra
+    semantics); '+group=option' appends it explicitly."""
+    (tmp_path / "extra_group").mkdir()
+    (tmp_path / "extra_group" / "opt.yaml").write_text("k: 1\n")
+    (tmp_path / "main.yaml").write_text("defaults:\n  - _self_\nfoo: 2\n")
+    with pytest.raises(ConfigError, match="not in main.yaml's defaults"):
+        compose_dict("main", overrides=["extra_group=opt"], config_path=tmp_path)
+    added = compose_dict(
+        "main", overrides=["+extra_group=opt"], config_path=tmp_path
+    )
+    assert added["extra_group"] == {"k": 1}
+    with pytest.raises(ConfigError, match="not a config group"):
+        compose_dict("main", overrides=["+nonexistent=opt"], config_path=tmp_path)
+
+
 def test_fp16_precision_accepted():
     cfg = compose(
         "cifar10_imp", overrides=["experiment_params.training_precision=float16"]

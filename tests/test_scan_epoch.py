@@ -115,3 +115,43 @@ def test_epoch_arrays_shapes_and_train_only():
     assert labels.shape == (4, 16)
     with pytest.raises(ValueError, match="drop_last"):
         loaders.test_loader.epoch_arrays()
+
+
+def test_scan_eval_matches_per_batch_eval():
+    """The one-program eval scan must produce the same sums as the per-batch
+    eval loop, including padded-row exclusion on the ragged final batch."""
+    from turboprune_tpu.parallel import make_sharded_eval_step, make_sharded_scan_eval
+    from turboprune_tpu.train import make_eval_step, make_scan_eval
+
+    loaders = SyntheticLoaders(
+        "CIFAR10", batch_size=16, image_size=8, num_classes=4,
+        num_train=64, num_test=24, seed=0,  # 24 -> 2 batches, last padded
+    )
+    model = create_model("resnet18", 4, "CIFAR10", compute_dtype=jnp.float32)
+    tx = create_optimizer("SGD", 0.1, momentum=0.9, weight_decay=5e-4)
+    mesh = create_mesh()
+    state = replicate(
+        create_train_state(model, tx, jax.random.PRNGKey(0), (1, 8, 8, 3)), mesh
+    )
+
+    raw_eval = make_eval_step(model)
+    eval_step = make_sharded_eval_step(raw_eval, mesh)
+    loop_sums = None
+    for batch in loaders.test_loader:
+        m = eval_step(state, shard_batch(batch, mesh))
+        loop_sums = m if loop_sums is None else jax.tree.map(jnp.add, loop_sums, m)
+
+    scan_eval = make_sharded_scan_eval(make_scan_eval(raw_eval), mesh)
+    stacked = loaders.test_loader.eval_epoch_arrays()
+    assert stacked[0].shape == (2, 16, 8, 8, 3)
+    assert int((stacked[1] < 0).sum()) == 8  # 32 slots - 24 real rows
+    scan_sums = scan_eval(
+        state, jax.device_put(stacked, epoch_sharding(mesh))
+    )
+    assert float(scan_sums["count"]) == float(loop_sums["count"]) == 24.0
+    np.testing.assert_allclose(
+        float(scan_sums["loss_sum"]), float(loop_sums["loss_sum"]), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        float(scan_sums["correct"]), float(loop_sums["correct"])
+    )

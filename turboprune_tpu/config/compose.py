@@ -74,11 +74,17 @@ def compose_dict(
     # regardless of argv order — a dotted override must never be clobbered
     # by a group override that appears later on the command line.
     group_overrides: dict[str, str] = {}
+    group_appends: dict[str, str] = {}
     dotted: list[tuple[list[str], object]] = []
     for item in overrides:
-        keys, value = _parse_override(item)
+        appending = item.startswith("+")
+        keys, value = _parse_override(item[1:] if appending else item)
         if len(keys) == 1 and isinstance(value, str) and (root / keys[0]).is_dir():
-            group_overrides[keys[0]] = value
+            (group_appends if appending else group_overrides)[keys[0]] = value
+        elif appending:
+            raise ConfigError(
+                f"+{keys[0]} is not a config group under {root}"
+            )
         else:
             dotted.append((keys, value))
 
@@ -97,9 +103,21 @@ def compose_dict(
         (group, option), = entry.items()
         seen_groups.add(group)
         resolved.append({group: group_overrides.get(group, option)})
-    for group, option in group_overrides.items():
-        if group not in seen_groups:  # group absent from defaults: append
-            resolved.append({group: option})
+    missing = set(group_overrides) - seen_groups
+    if missing:
+        # Hydra semantics: overriding a group the defaults list doesn't
+        # select is an error; '+group=option' appends explicitly.
+        raise ConfigError(
+            f"config group(s) {sorted(missing)} are not in {name}.yaml's "
+            f"defaults list — use '+<group>=<option>' to add one"
+        )
+    for group, option in group_appends.items():
+        if group in seen_groups:
+            raise ConfigError(
+                f"+{group}={option}: group already in the defaults list — "
+                f"override it with '{group}={option}' (no plus)"
+            )
+        resolved.append({group: option})
 
     merged: dict = {}
     self_merged = False

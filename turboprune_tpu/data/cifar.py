@@ -222,6 +222,25 @@ class DeviceCifarLoader:
             labels[:used].reshape(s, self.batch_size),
         )
 
+    def eval_epoch_arrays(self) -> Batch:
+        """The static eval set stacked on a step axis: images [S, B, ...],
+        labels [S, B], final batch padded with sentinel label -1 (masked by
+        the eval step) — input for the lax.scan eval runner
+        (train/steps.py make_scan_eval). Eval-mode only. NOT cached here:
+        the harness keeps the one device-resident copy (sharded for its
+        mesh); a loader-side cache would pin a duplicate in HBM for the
+        whole run. Building the stack is a cheap pad+reshape of ``_base``."""
+        if self.drop_last:
+            raise ValueError("eval_epoch_arrays is for eval mode")
+        s = len(self)
+        images, labels = pad_eval_batch(
+            self._base, self.labels, s * self.batch_size
+        )
+        return (
+            images.reshape((s, self.batch_size) + images.shape[1:]),
+            labels.reshape(s, self.batch_size),
+        )
+
     def __iter__(self) -> Iterator[Batch]:
         images, labels = self._epoch_data()
         n = self.labels.shape[0]

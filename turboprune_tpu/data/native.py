@@ -249,7 +249,29 @@ class TpkImageLoader:
         max_shard = -(-self.file.num_samples // nproc)
         return -(-max_shard // self.batch_size)
 
+    def _decode_batch(self, order: np.ndarray, b: int, epoch: int):
+        idx = order[b * self.batch_size : (b + 1) * self.batch_size]
+        if self.file.mode == 1:
+            images, labels = self.file.decode(
+                idx,
+                self.image_size,
+                self.train,
+                seed=self.seed * 1_000_003 + epoch,
+                nthreads=self.nthreads,
+            )
+        else:
+            images, labels = self.file.read_raw(idx, nthreads=self.nthreads)
+        if not self.train:
+            images, labels = pad_eval_batch(images, labels, self.batch_size)
+        return images, labels
+
     def __iter__(self) -> Iterator[tuple[jax.Array, jax.Array]]:
+        """Decode batch b+1 on a background thread while batch b is on
+        device (FFCV's pipelined-decode architecture): the C++ decode
+        releases the GIL inside its worker threads, so host decode overlaps
+        the accelerator step dispatched between ``next()`` calls."""
+        from concurrent.futures import ThreadPoolExecutor
+
         from .imagenet import _normalize_device
 
         epoch = self.epoch
@@ -258,21 +280,16 @@ class TpkImageLoader:
         if self.train:
             rng = np.random.default_rng(self.seed + epoch)
             order = rng.permutation(order)
-        for b in range(len(self)):
-            idx = order[b * self.batch_size : (b + 1) * self.batch_size]
-            if self.file.mode == 1:
-                images, labels = self.file.decode(
-                    idx,
-                    self.image_size,
-                    self.train,
-                    seed=self.seed * 1_000_003 + epoch,
-                    nthreads=self.nthreads,
-                )
-            else:
-                images, labels = self.file.read_raw(idx, nthreads=self.nthreads)
-            if not self.train:
-                images, labels = pad_eval_batch(images, labels, self.batch_size)
-            yield _normalize_device(jnp.asarray(images)), jnp.asarray(labels)
+        n = len(self)
+        if n == 0:
+            return
+        with ThreadPoolExecutor(max_workers=1) as pool:
+            pending = pool.submit(self._decode_batch, order, 0, epoch)
+            for b in range(n):
+                images, labels = pending.result()
+                if b + 1 < n:
+                    pending = pool.submit(self._decode_batch, order, b + 1, epoch)
+                yield _normalize_device(jnp.asarray(images)), jnp.asarray(labels)
 
 
 class TpkLoaders:

@@ -42,6 +42,7 @@ from ..parallel import (
     epoch_sharding,
     make_sharded_eval_step,
     make_sharded_scan_epoch,
+    make_sharded_scan_eval,
     make_sharded_train_step,
     replicate,
 )
@@ -53,6 +54,7 @@ from ..train import (
     eval_params,
     make_eval_step,
     make_scan_epoch,
+    make_scan_eval,
     make_train_step,
 )
 from ..utils import (
@@ -138,9 +140,10 @@ class PruningHarness:
             )
         self.state = replicate(state, self.mesh)
 
-        self._eval_step = make_sharded_eval_step(
-            make_eval_step(self.model), self.mesh
-        )
+        raw_eval = make_eval_step(self.model)
+        self._eval_step = make_sharded_eval_step(raw_eval, self.mesh)
+        self._scan_eval = make_sharded_scan_eval(make_scan_eval(raw_eval), self.mesh)
+        self._eval_batches = None  # device-cached stacked test set
 
     # ------------------------------------------------------------------ tx
     def _build_tx(self, epochs: int):
@@ -267,16 +270,26 @@ class PruningHarness:
             ev_state = ev_state.replace(
                 params=eval_params(ev_state.opt_state, ev_state.params)
             )
-        sums = None
         test_loader = self.loaders.test_loader
-        test_scope = getattr(test_loader, "batch_scope", "global")
-        for batch in test_loader:
-            batch = assemble_batch(batch, self.mesh, test_scope)
-            m = self._eval_step(ev_state, batch)
-            sums = m if sums is None else jax.tree.map(jnp.add, sums, m)
-        if sums is None:
-            raise RuntimeError("test loader yielded no batches")
-        sums = jax.device_get(sums)
+        if hasattr(test_loader, "eval_epoch_arrays"):
+            # Device-resident eval: the padded stacked test set is cached in
+            # HBM once and the whole pass runs as ONE lax.scan program —
+            # matching the train scan path's zero-dispatch hot loop.
+            if self._eval_batches is None:
+                self._eval_batches = jax.device_put(
+                    test_loader.eval_epoch_arrays(), epoch_sharding(self.mesh)
+                )
+            sums = jax.device_get(self._scan_eval(ev_state, self._eval_batches))
+        else:
+            sums = None
+            test_scope = getattr(test_loader, "batch_scope", "global")
+            for batch in test_loader:
+                batch = assemble_batch(batch, self.mesh, test_scope)
+                m = self._eval_step(ev_state, batch)
+                sums = m if sums is None else jax.tree.map(jnp.add, sums, m)
+            if sums is None:
+                raise RuntimeError("test loader yielded no batches")
+            sums = jax.device_get(sums)
         n = float(sums["count"])
         return {
             "test_loss": float(sums["loss_sum"]) / n,

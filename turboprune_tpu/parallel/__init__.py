@@ -8,6 +8,7 @@ from .mesh import (
     epoch_sharding,
     make_sharded_eval_step,
     make_sharded_scan_epoch,
+    make_sharded_scan_eval,
     make_sharded_train_step,
     replicate,
     replicated,
@@ -35,6 +36,7 @@ __all__ = [
     "shard_batch",
     "epoch_sharding",
     "make_sharded_scan_epoch",
+    "make_sharded_scan_eval",
     "make_sharded_train_step",
     "make_sharded_eval_step",
     "initialize_distributed",

@@ -123,6 +123,17 @@ def epoch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(None, DATA_AXIS))
 
 
+def make_sharded_scan_eval(scan_eval: Callable, mesh: Mesh) -> Callable:
+    """jit the lax.scan eval runner (train/steps.py make_scan_eval): state
+    replicated (NOT donated — it is reused for training), stacked batches
+    sharded on the batch axis."""
+    return jax.jit(
+        scan_eval,
+        in_shardings=(replicated(mesh), epoch_sharding(mesh)),
+        out_shardings=replicated(mesh),
+    )
+
+
 def make_sharded_scan_epoch(
     scan_epoch: Callable, mesh: Mesh, donate_state: bool = True
 ) -> Callable:

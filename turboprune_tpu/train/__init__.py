@@ -11,6 +11,7 @@ from .schedules import (
 )
 from .state import TrainState, create_train_state, init_variables, reset_optimizer
 from .steps import (cross_entropy_sum, make_eval_step, make_scan_epoch,
+                    make_scan_eval,
                     make_train_step)
 
 __all__ = [
@@ -30,6 +31,7 @@ __all__ = [
     "onecycle_schedule",
     "make_train_step",
     "make_scan_epoch",
+    "make_scan_eval",
     "make_eval_step",
     "cross_entropy_sum",
 ]

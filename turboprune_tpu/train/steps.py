@@ -129,6 +129,25 @@ def make_scan_epoch(
     return scan_epoch
 
 
+def make_scan_eval(
+    eval_step: Callable[[TrainState, Batch], dict],
+) -> Callable[[TrainState, Batch], dict]:
+    """Whole-test-set eval as ONE compiled program (the eval analog of
+    make_scan_epoch): batches stacked [S, B, ...] with padded rows carrying
+    label -1, scanned with the state as a constant carry. On 150-epoch CIFAR
+    levels eval runs every epoch — per-batch dispatch was the one remaining
+    host-loop in the level (VERDICT r3 weak #7)."""
+
+    def scan_eval(state: TrainState, batches: Batch) -> dict:
+        def body(s, batch):
+            return s, eval_step(s, batch)
+
+        _, ms = jax.lax.scan(body, state, batches)
+        return {k: jnp.sum(v) for k, v in ms.items()}
+
+    return scan_eval
+
+
 def make_eval_step(model) -> Callable[[TrainState, Batch], dict]:
     """Pure eval step (reference test_step, base_harness.py:136-149).
 
