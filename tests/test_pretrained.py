@@ -1,0 +1,203 @@
+"""Pretrained DeiT checkpoint conversion (models/pretrained.py).
+
+The oracle is a functional torch implementation of the timm DeiT forward
+(the exact compute the reference's deit.py models run) applied to the SAME
+random state_dict that the converter maps onto the flax tree — agreement of
+the two forwards proves every transpose/split in the layout mapping.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+F = torch.nn.functional
+
+import jax
+import jax.numpy as jnp
+
+from turboprune_tpu.models.pretrained import (
+    PretrainedFormatError,
+    convert_deit_state_dict,
+    load_pretrained,
+    load_torch_state_dict,
+)
+from turboprune_tpu.models.vit import VisionTransformer
+
+# Tiny distilled DeiT: patch 4 on 8x8 -> 4 patches + cls + dist tokens.
+D, DEPTH, HEADS, P, IMG, NCLS = 16, 2, 2, 4, 8, 5
+
+
+def make_timm_state_dict(num_classes=NCLS, distilled=True, seed=0):
+    g = torch.Generator().manual_seed(seed)
+
+    def r(*shape):
+        return torch.randn(*shape, generator=g) * 0.1
+
+    sd = {
+        "cls_token": r(1, 1, D),
+        "pos_embed": r(1, (IMG // P) ** 2 + (2 if distilled else 1), D),
+        "patch_embed.proj.weight": r(D, 3, P, P),
+        "patch_embed.proj.bias": r(D),
+        "norm.weight": 1 + 0.1 * r(D),
+        "norm.bias": r(D),
+        "head.weight": r(num_classes, D),
+        "head.bias": r(num_classes),
+    }
+    if distilled:
+        sd["dist_token"] = r(1, 1, D)
+        sd["head_dist.weight"] = r(num_classes, D)
+        sd["head_dist.bias"] = r(num_classes)
+    for i in range(DEPTH):
+        b = f"blocks.{i}"
+        sd.update(
+            {
+                f"{b}.norm1.weight": 1 + 0.1 * r(D),
+                f"{b}.norm1.bias": r(D),
+                f"{b}.attn.qkv.weight": r(3 * D, D),
+                f"{b}.attn.qkv.bias": r(3 * D),
+                f"{b}.attn.proj.weight": r(D, D),
+                f"{b}.attn.proj.bias": r(D),
+                f"{b}.norm2.weight": 1 + 0.1 * r(D),
+                f"{b}.norm2.bias": r(D),
+                f"{b}.mlp.fc1.weight": r(4 * D, D),
+                f"{b}.mlp.fc1.bias": r(4 * D),
+                f"{b}.mlp.fc2.weight": r(D, 4 * D),
+                f"{b}.mlp.fc2.bias": r(D),
+            }
+        )
+    return sd
+
+
+def timm_forward(sd: dict, x: torch.Tensor, distilled=True) -> torch.Tensor:
+    """timm VisionTransformer/DeiT eval forward, functional on the state
+    dict (matches timm's pre-LN blocks, exact GELU, eps=1e-6, scale
+    head_dim**-0.5; reference models are these exact modules)."""
+    n = x.shape[0]
+    x = F.conv2d(x, sd["patch_embed.proj.weight"], sd["patch_embed.proj.bias"], stride=P)
+    x = x.flatten(2).transpose(1, 2)  # (N, patches, D)
+    tokens = [sd["cls_token"].expand(n, -1, -1)]
+    if distilled:
+        tokens.append(sd["dist_token"].expand(n, -1, -1))
+    x = torch.cat(tokens + [x], dim=1) + sd["pos_embed"]
+    head_dim = D // HEADS
+    for i in range(DEPTH):
+        b = f"blocks.{i}"
+        y = F.layer_norm(x, (D,), sd[f"{b}.norm1.weight"], sd[f"{b}.norm1.bias"], 1e-6)
+        qkv = F.linear(y, sd[f"{b}.attn.qkv.weight"], sd[f"{b}.attn.qkv.bias"])
+        q, k, v = qkv.chunk(3, dim=-1)
+
+        def heads(t):
+            return t.reshape(n, -1, HEADS, head_dim).transpose(1, 2)
+
+        attn = torch.softmax(
+            heads(q) @ heads(k).transpose(-2, -1) * head_dim**-0.5, dim=-1
+        )
+        y = (attn @ heads(v)).transpose(1, 2).reshape(n, -1, D)
+        y = F.linear(y, sd[f"{b}.attn.proj.weight"], sd[f"{b}.attn.proj.bias"])
+        x = x + y
+        y = F.layer_norm(x, (D,), sd[f"{b}.norm2.weight"], sd[f"{b}.norm2.bias"], 1e-6)
+        y = F.gelu(F.linear(y, sd[f"{b}.mlp.fc1.weight"], sd[f"{b}.mlp.fc1.bias"]))
+        y = F.linear(y, sd[f"{b}.mlp.fc2.weight"], sd[f"{b}.mlp.fc2.bias"])
+        x = x + y
+    x = F.layer_norm(x, (D,), sd["norm.weight"], sd["norm.bias"], 1e-6)
+    out = F.linear(x[:, 0], sd["head.weight"], sd["head.bias"])
+    if distilled:
+        out_d = F.linear(x[:, 1], sd["head_dist.weight"], sd["head_dist.bias"])
+        out = (out + out_d) / 2
+    return out
+
+
+def make_model(distilled=True, num_classes=NCLS):
+    return VisionTransformer(
+        num_classes=num_classes,
+        patch_size=P,
+        embed_dim=D,
+        depth=DEPTH,
+        num_heads=HEADS,
+        distilled=distilled,
+    )
+
+
+@pytest.mark.parametrize("distilled", [False, True])
+def test_forward_matches_timm_oracle(distilled):
+    sd = make_timm_state_dict(distilled=distilled)
+    model = make_model(distilled)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, IMG, IMG, 3)))["params"]
+    converted, skipped = convert_deit_state_dict(
+        {k: v.numpy() for k, v in sd.items()}, params, num_heads=HEADS
+    )
+    assert skipped == []
+
+    x = np.random.default_rng(1).normal(size=(3, IMG, IMG, 3)).astype(np.float32)
+    ours = np.asarray(model.apply({"params": converted}, jnp.asarray(x), train=False))
+    theirs = (
+        timm_forward(sd, torch.from_numpy(x).permute(0, 3, 1, 2), distilled)
+        .detach()
+        .numpy()
+    )
+    np.testing.assert_allclose(ours, theirs, atol=2e-5, rtol=2e-5)
+
+
+def test_head_mismatch_keeps_init_head():
+    sd = make_timm_state_dict(num_classes=1000)  # "ImageNet" checkpoint
+    model = make_model(num_classes=NCLS)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, IMG, IMG, 3)))["params"]
+    converted, skipped = convert_deit_state_dict(
+        {k: v.numpy() for k, v in sd.items()}, params, num_heads=HEADS
+    )
+    assert sorted(skipped) == ["head", "head_dist"]
+    np.testing.assert_array_equal(converted["head"]["kernel"], params["head"]["kernel"])
+    # Backbone still converted.
+    np.testing.assert_allclose(
+        np.asarray(converted["norm"]["scale"]), sd["norm.weight"].numpy(), atol=0
+    )
+
+
+def test_rejects_wrong_depth():
+    sd = make_timm_state_dict()
+    extra = {k.replace("blocks.1", "blocks.9"): v for k, v in sd.items() if "blocks.1." in k}
+    model = make_model()
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, IMG, IMG, 3)))["params"]
+    with pytest.raises(PretrainedFormatError, match="unconsumed"):
+        convert_deit_state_dict(
+            {k: v.numpy() for k, v in {**sd, **extra}.items()}, params, HEADS
+        )
+    missing = {k: v.numpy() for k, v in sd.items() if "blocks.1." not in k}
+    before = np.asarray(params["block0"]["norm1"]["scale"]).copy()
+    with pytest.raises(PretrainedFormatError, match="missing"):
+        convert_deit_state_dict(missing, params, HEADS)
+    # A mid-conversion failure must not have touched the caller's tree
+    # (block0 converts before the block1 tensors are found missing).
+    np.testing.assert_array_equal(
+        np.asarray(params["block0"]["norm1"]["scale"]), before
+    )
+
+
+def test_load_from_file_deit_wrapper(tmp_path):
+    """Round-trip through the DeiT-release {"model": sd} file format."""
+    sd = make_timm_state_dict()
+    path = tmp_path / "deit_tiny.pth"
+    torch.save({"model": sd}, path)
+    model = make_model()
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, IMG, IMG, 3)))["params"]
+    loaded = load_pretrained(path, model, params)
+    np.testing.assert_allclose(
+        np.asarray(loaded["cls_token"]), sd["cls_token"].numpy()
+    )
+    assert load_torch_state_dict(path).keys() == sd.keys()
+    with pytest.raises(FileNotFoundError):
+        load_pretrained(tmp_path / "nope.pth", model, params)
+
+
+def test_config_rejects_pretrained_on_cnn():
+    from turboprune_tpu.config.schema import ConfigError, config_from_dict
+
+    with pytest.raises(ConfigError, match="deit"):
+        config_from_dict(
+            {
+                "model_params": {
+                    "model_name": "resnet18",
+                    "pretrained_path": "/tmp/x.pth",
+                }
+            }
+        )

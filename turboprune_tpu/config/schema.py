@@ -32,6 +32,7 @@ TRAINING_TYPES = ("imp", "wr", "lrr", "at_init")
 # bfloat16 is the native fast dtype and the recommended default (fp16 has
 # no hardware advantage and a narrower exponent range).
 PRECISIONS = ("bfloat16", "float16", "float32")
+ATTENTION_IMPLS = ("dense", "ring")
 OPTIMIZERS = ("SGD", "AdamW", "ScheduleFreeSGD")
 SCHEDULERS = (
     "MultiStepLRWarmup",
@@ -118,11 +119,32 @@ class ModelConfig:
     # (standard_pruning_harness.py:141); jit is unconditional here, the knob is
     # accepted for config compatibility and ignored.
     use_compile: bool = False
+    # Local timm/DeiT torch checkpoint to warm-start ViT weights from
+    # (reference deit.py:82-89 downloads these; no egress here, so the file
+    # is staged by the user). Empty = random init. ViT models only.
+    pretrained_path: str = ""
+    # "ring" = sequence-parallel ring attention over the mesh model axis
+    # (parallel/ring.py); pair with experiment_params.model_parallelism > 1.
+    # ViT models only; params/checkpoints identical to "dense".
+    attention_impl: str = "dense"
 
     def validate(self) -> None:
         _check_choice(
             "model_params.mask_layer_type", self.mask_layer_type, MASK_LAYER_TYPES
         )
+        _check_choice(
+            "model_params.attention_impl", self.attention_impl, ATTENTION_IMPLS
+        )
+        if self.pretrained_path and not self.model_name.startswith("deit"):
+            raise ConfigError(
+                "pretrained_path is only supported for deit_* models "
+                f"(got model_name={self.model_name!r})"
+            )
+        if self.attention_impl != "dense" and not self.model_name.startswith("deit"):
+            raise ConfigError(
+                "attention_impl=ring requires a deit_* model "
+                f"(got model_name={self.model_name!r})"
+            )
 
 
 @dataclass
@@ -173,6 +195,10 @@ class ExperimentConfig:
     wandb_project_name: str = "TurboPrune_runs"
     # TPU additions: mesh axes sizes; 0 = use all visible devices on `data`.
     num_devices: int = 0
+    # Size of the mesh `model` axis (sequence/tensor parallelism); devices
+    # are laid out (data = n/model_parallelism, model). 1 = pure DP, the
+    # reference's only strategy (SURVEY.md §2.3).
+    model_parallelism: int = 1
     # Cap on train/eval steps per epoch (0 = full epoch) — for smoke tests.
     max_steps_per_epoch: int = 0
     log_every_steps: int = 50
@@ -186,6 +212,8 @@ class ExperimentConfig:
         )
         if self.epochs_per_level <= 0:
             raise ConfigError("epochs_per_level must be positive")
+        if self.model_parallelism < 1:
+            raise ConfigError("model_parallelism must be >= 1")
 
 
 @dataclass
@@ -239,6 +267,18 @@ class MainConfig:
         # of level 0 (cycle 0 for cyclic) — an out-of-range value would
         # silently never save model_rewind and crash at the level-1 rewind
         # AFTER burning all of level 0's compute.
+        # model axis > 1 is only consumed by ring attention today; with
+        # dense attention every model-axis device would redundantly compute
+        # the same gradients at 1/model_parallelism throughput — reject.
+        if (
+            self.experiment_params.model_parallelism > 1
+            and self.model_params.attention_impl != "ring"
+        ):
+            raise ConfigError(
+                "model_parallelism > 1 requires model_params.attention_impl="
+                "ring (nothing else uses the model axis; dense attention "
+                "would silently duplicate compute across it)"
+            )
         rewind_epoch = self.pruning_params.rewind_epoch
         if rewind_epoch is not None:
             from ..pruning.densities import generate_cyclical_schedule

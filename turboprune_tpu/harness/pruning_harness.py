@@ -91,14 +91,18 @@ class PruningHarness:
         ep = cfg.experiment_params
         self.compute_dtype = PRECISION_DTYPES[ep.training_precision]
 
+        self.mesh = create_mesh(
+            num_devices=ep.num_devices, model_parallelism=ep.model_parallelism
+        )
         self.model = create_model(
             cfg.model_params.model_name,
             num_classes=cfg.dataset_params.num_classes,
             dataset_name=cfg.dataset_params.dataset_name,
             compute_dtype=self.compute_dtype,
+            attention_impl=cfg.model_params.attention_impl,
+            mesh=self.mesh,
         )
         self.loaders = loaders if loaders is not None else create_loaders(cfg)
-        self.mesh = create_mesh(num_devices=ep.num_devices)
         data_size = self.mesh.shape["data"]
         per_host_batch = cfg.dataset_params.total_batch_size // max(
             jax.process_count(), 1
@@ -138,6 +142,19 @@ class PruningHarness:
                 jax.random.PRNGKey(ep.seed),
                 input_shape,
             )
+            if cfg.model_params.pretrained_path:
+                # Warm-start ViT weights from a local timm checkpoint
+                # (reference deit.py:82-89; models/pretrained.py). Applied to
+                # the fresh init only — resume/level restores keep their own
+                # weights — and before the level-0 MODEL_INIT save, so the
+                # imp rewind target carries the pretrained weights.
+                from ..models.pretrained import load_pretrained
+
+                state = state.replace(
+                    params=load_pretrained(
+                        cfg.model_params.pretrained_path, self.model, state.params
+                    )
+                )
         self.state = replicate(state, self.mesh)
 
         raw_eval = make_eval_step(self.model)

@@ -48,18 +48,30 @@ def create_model(
     num_classes: int,
     dataset_name: str = "CIFAR10",
     compute_dtype: Any = jnp.float32,
+    attention_impl: str = "dense",
+    mesh: Any = None,
 ):
     """Build a model module with dataset-appropriate stem.
 
     CIFAR datasets get the reference's stem surgery
-    (custom_models.py:197-215) via ``cifar_stem=True``."""
+    (custom_models.py:197-215) via ``cifar_stem=True``. ViT models accept
+    ``attention_impl="ring"`` + a mesh for sequence-parallel attention
+    (parallel/ring.py); CNNs reject it (no attention to shard)."""
     if model_name not in MODEL_REGISTRY:
         raise ValueError(
             f"Model {model_name!r} not in registry: {sorted(MODEL_REGISTRY)}"
         )
     cifar_stem = dataset_name.lower() in ("cifar10", "cifar100")
+    kwargs = {}
+    if model_name.startswith("deit"):
+        kwargs = {"attention_impl": attention_impl, "mesh": mesh}
+    elif attention_impl != "dense":
+        raise ValueError(
+            f"attention_impl={attention_impl!r} requires a ViT model "
+            f"(got {model_name!r})"
+        )
     return MODEL_REGISTRY[model_name](
-        num_classes, cifar_stem=cifar_stem, dtype=compute_dtype
+        num_classes, cifar_stem=cifar_stem, dtype=compute_dtype, **kwargs
     )
 
 

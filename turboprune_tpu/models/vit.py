@@ -22,6 +22,55 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 
+class RingSelfAttention(nn.Module):
+    """Sequence-parallel self-attention (ring attention over the mesh
+    ``model`` axis, parallel/ring.py).
+
+    Drop-in replacement for ``nn.MultiHeadDotProductAttention`` with an
+    IDENTICAL param tree (query/key/value DenseGeneral (D, H, D/H) + out
+    DenseGeneral (H, D/H, D)), so checkpoints, masks, and the pruning
+    predicate (ops/masking.py:31-39) are interchangeable between the dense
+    and ring implementations. Sequences that don't divide the ring size are
+    padded here and the padding masked out of the softmax.
+
+    Attention dropout is not supported on the ring path (the reference's
+    DeiT configs use attn_drop=0 anyway, /root/reference/utils/deit.py).
+    """
+
+    num_heads: int
+    mesh: Any  # jax.sharding.Mesh (static module metadata)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        from ..parallel.mesh import MODEL_AXIS
+        from ..parallel.ring import ring_attention
+
+        if self.mesh is None:
+            raise ValueError(
+                "attention_impl='ring' needs a mesh (create_model(..., "
+                "mesh=...) — the harness passes its own)"
+            )
+        d = x.shape[-1]
+        h = self.num_heads
+        hd = d // h
+        q = nn.DenseGeneral((h, hd), dtype=self.dtype, name="query")(x)
+        k = nn.DenseGeneral((h, hd), dtype=self.dtype, name="key")(x)
+        v = nn.DenseGeneral((h, hd), dtype=self.dtype, name="value")(x)
+
+        seq = x.shape[1]
+        ring = self.mesh.shape[MODEL_AXIS]
+        pad = (-seq) % ring
+        if pad:
+            widths = ((0, 0), (0, pad), (0, 0), (0, 0))
+            q, k, v = (jnp.pad(t, widths) for t in (q, k, v))
+        valid = jnp.arange(seq + pad) < seq
+        out = ring_attention(q, k, v, valid, self.mesh)[:, :seq]
+        return nn.DenseGeneral(
+            d, axis=(-2, -1), dtype=self.dtype, name="out"
+        )(out)
+
+
 class MlpBlock(nn.Module):
     hidden_dim: int
     out_dim: int
@@ -44,18 +93,28 @@ class EncoderBlock(nn.Module):
     dropout_rate: float = 0.0
     attn_dropout_rate: float = 0.0
     dtype: Any = jnp.float32
+    attention_impl: str = "dense"  # "dense" | "ring" (sequence-parallel)
+    mesh: Any = None  # required for attention_impl="ring"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         dim = x.shape[-1]
         y = nn.LayerNorm(epsilon=1e-6, name="norm1")(x)
-        y = nn.MultiHeadDotProductAttention(
-            num_heads=self.num_heads,
-            dtype=self.dtype,
-            dropout_rate=self.attn_dropout_rate,
-            deterministic=not train,
-            name="attn",
-        )(y, y)
+        if self.attention_impl == "ring":
+            y = RingSelfAttention(
+                num_heads=self.num_heads,
+                mesh=self.mesh,
+                dtype=self.dtype,
+                name="attn",
+            )(y)
+        else:
+            y = nn.MultiHeadDotProductAttention(
+                num_heads=self.num_heads,
+                dtype=self.dtype,
+                dropout_rate=self.attn_dropout_rate,
+                deterministic=not train,
+                name="attn",
+            )(y, y)
         x = x + y
         y = nn.LayerNorm(epsilon=1e-6, name="norm2")(x)
         y = MlpBlock(
@@ -78,6 +137,11 @@ class VisionTransformer(nn.Module):
     dropout_rate: float = 0.0
     distilled: bool = False
     dtype: Any = jnp.float32
+    # Sequence/context parallelism: "ring" shards tokens over the mesh
+    # `model` axis and runs ring attention (parallel/ring.py). Identical
+    # params/checkpoints to "dense".
+    attention_impl: str = "dense"
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -123,6 +187,8 @@ class VisionTransformer(nn.Module):
                 mlp_ratio=self.mlp_ratio,
                 dropout_rate=self.dropout_rate,
                 dtype=self.dtype,
+                attention_impl=self.attention_impl,
+                mesh=self.mesh,
                 name=f"block{i}",
             )(x, train=train)
         x = nn.LayerNorm(epsilon=1e-6, name="norm")(x)

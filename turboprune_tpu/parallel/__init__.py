@@ -15,6 +15,7 @@ from .mesh import (
     assemble_batch,
     shard_batch,
 )
+from .ring import ring_attention
 from .multihost import (
     broadcast_object,
     check_state_equality,
@@ -39,6 +40,7 @@ __all__ = [
     "make_sharded_scan_eval",
     "make_sharded_train_step",
     "make_sharded_eval_step",
+    "ring_attention",
     "initialize_distributed",
     "is_primary",
     "process_index",
