@@ -11,10 +11,12 @@ numbers VERDICT r3 asked for:
                            conf/dataset_params/dp_imagenet_ffcv.yaml:3)
   resnet50_tflops_per_sec  achieved model TFLOP/s (XLA cost analysis)
   resnet50_mfu             achieved / peak for the detected chip kind
-  tpk_decode_img_per_sec   native .tpk JPEG decode->device throughput
-  grain_decode_img_per_sec grain pipeline decode->device throughput
+  tpk_decode_img_per_sec   native .tpk JPEG decode HOST throughput
+  grain_decode_img_per_sec grain pipeline decode HOST throughput
+                           (decode -> host uint8 batch; device transfer
+                           excluded — see _steady_epochs for why)
   resnet50_fed_img_per_sec ResNet50 step throughput with the tpk pipeline
-                           actually feeding (decode overlap included)
+                           actually feeding (decode + transfer + train)
 
 Baseline: the reference's only published number — ResNet18/ImageNet at
 1:09 min/epoch on 4x A100 with FFCV (/root/reference/README.md:8) =
@@ -133,12 +135,15 @@ def bench_train(model_name: str, batch_size: int) -> tuple[float, float | None]:
 
 
 # ----------------------------------------------------------- input pipeline
-def _ensure_jpeg_dataset(root: Path, n: int = 1024, size: int = 256) -> Path:
+def _ensure_jpeg_dataset(root: Path, n: int = 2048, size: int = 256) -> Path:
     """Synthetic-JPEG ImageFolder (2 classes) for pipeline benches; cached."""
     split = root / "train"
     marker = root / f".done_{n}_{size}"
     if marker.exists():
         return split
+    # Regenerating the JPEGs (size knobs changed) invalidates any .tpk
+    # packed from the previous set — remove it so the tpk bench repacks.
+    (root / "train.tpk").unlink(missing_ok=True)
     from PIL import Image
 
     rng = np.random.default_rng(0)
@@ -156,21 +161,52 @@ def _ensure_jpeg_dataset(root: Path, n: int = 1024, size: int = 256) -> Path:
     return split
 
 
+def _steady_epochs(epoch_fn, epochs: int = 3) -> float:
+    """img/s over epochs 2..N — epoch 1 is discarded as warmup. Measuring a
+    single short epoch flatters prefetching loaders (workers decode the
+    whole tail during the first batch's latency), so the rate must be taken
+    at steady state.
+
+    Both decode benches measure the HOST pipeline (decode -> host uint8
+    batch). The device transfer is deliberately excluded: on this axon
+    tunnel it is the bottleneck (~30-120 MB/s and highly variable between
+    runs, capping ANY pipeline at a few hundred img/s), whereas a real
+    TPU-VM host feeds over local PCIe. The fed-resnet50 number below keeps
+    the full transfer+train path for the honest end-to-end figure on THIS
+    setup."""
+    n, t = 0, 0.0
+    for e in range(epochs):
+        t0 = time.perf_counter()
+        count = epoch_fn()
+        dt = time.perf_counter() - t0
+        if e > 0:
+            n += count
+            t += dt
+    return n / t
+
+
 def bench_tpk_decode(split: Path, root: Path, batch: int = 256) -> float:
-    from turboprune_tpu.data.native import TpkImageLoader, pack_imagefolder
+    from turboprune_tpu.data.native import TpkFile, pack_imagefolder
 
     tpk = root / "train.tpk"
     if not tpk.exists():
         pack_imagefolder(split, tpk)
-    loader = TpkImageLoader(tpk, total_batch_size=batch, train=True, image_size=224)
-    # warmup one batch (thread pool spin-up + jit of normalize)
-    it = iter(loader)
-    next(it)[0].block_until_ready()
-    n, t0 = 0, time.perf_counter()
-    for images, _ in it:
-        images.block_until_ready()
-        n += images.shape[0]
-    return n / (time.perf_counter() - t0)
+    f = TpkFile(tpk)
+    rng = np.random.default_rng(0)
+    nthreads = min(16, os.cpu_count() or 1)
+
+    def one_epoch() -> int:
+        order = rng.permutation(f.num_samples).astype(np.int64)
+        count = 0
+        for b in range(f.num_samples // batch):
+            idx = order[b * batch : (b + 1) * batch]
+            images, _ = f.decode(idx, 224, train=True, seed=b, nthreads=nthreads)
+            count += images.shape[0]
+        return count
+
+    rate = _steady_epochs(one_epoch)
+    f.close()
+    return rate
 
 
 def bench_grain_decode(split: Path, batch: int = 256, workers: int = 2) -> float:
@@ -179,13 +215,11 @@ def bench_grain_decode(split: Path, batch: int = 256, workers: int = 2) -> float
     loader = GrainImageLoader(
         str(split), total_batch_size=batch, train=True, num_workers=workers
     )
-    it = iter(loader)
-    next(it)[0].block_until_ready()
-    n, t0 = 0, time.perf_counter()
-    for images, _ in it:
-        images.block_until_ready()
-        n += images.shape[0]
-    return n / (time.perf_counter() - t0)
+
+    def one_epoch() -> int:
+        return sum(images.shape[0] for images, _ in loader._raw_batches())
+
+    return _steady_epochs(one_epoch)
 
 
 def bench_fed_resnet50(split: Path, root: Path, batch: int = 256) -> float:
@@ -200,14 +234,19 @@ def bench_fed_resnet50(split: Path, root: Path, batch: int = 256) -> float:
     loader = TpkImageLoader(
         root / "train.tpk", total_batch_size=batch, train=True, image_size=224
     )
-    n = 0
-    t0 = time.perf_counter()
-    for epoch in range(2):
+    n, t = 0, 0.0
+    for epoch in range(3):  # epoch 0 discarded (buffer warmup)
+        t0 = time.perf_counter()
+        count = 0
         for images, labels in loader:
             state, metrics = step(state, (images, labels))
-            n += images.shape[0]
-    float(metrics["loss_sum"])
-    return n / (time.perf_counter() - t0)
+            count += images.shape[0]
+        float(metrics["loss_sum"])  # sync before closing the epoch timer
+        dt = time.perf_counter() - t0
+        if epoch > 0:
+            n += count
+            t += dt
+    return n / t
 
 
 def _log(msg: str) -> None:

@@ -263,13 +263,10 @@ class MainConfig:
             sub = getattr(self, f.name)
             if sub is not None and hasattr(sub, "validate"):
                 sub.validate()
-        # Cross-group: the rewind snapshot is taken at epoch == rewind_epoch
-        # of level 0 (cycle 0 for cyclic) — an out-of-range value would
-        # silently never save model_rewind and crash at the level-1 rewind
-        # AFTER burning all of level 0's compute.
-        # model axis > 1 is only consumed by ring attention today; with
-        # dense attention every model-axis device would redundantly compute
-        # the same gradients at 1/model_parallelism throughput — reject.
+        # Cross-group: model axis > 1 is only consumed by ring attention
+        # today; with dense attention every model-axis device would
+        # redundantly compute the same gradients at 1/model_parallelism
+        # throughput — reject.
         if (
             self.experiment_params.model_parallelism > 1
             and self.model_params.attention_impl != "ring"
@@ -279,6 +276,10 @@ class MainConfig:
                 "ring (nothing else uses the model axis; dense attention "
                 "would silently duplicate compute across it)"
             )
+        # Cross-group: the rewind snapshot is taken at epoch == rewind_epoch
+        # of level 0 (cycle 0 for cyclic) — an out-of-range value would
+        # silently never save model_rewind and crash at the level-1 rewind
+        # AFTER burning all of level 0's compute.
         rewind_epoch = self.pruning_params.rewind_epoch
         if rewind_epoch is not None:
             from ..pruning.densities import generate_cyclical_schedule
