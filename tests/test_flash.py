@@ -1,0 +1,119 @@
+"""First-party Pallas flash attention (ops/flash.py).
+
+Runs in interpret mode on the CPU suite — the exact kernel program executed
+by XLA ops — and is checked against a dense jnp oracle for both the forward
+values and all three input gradients (the custom-VJP backward kernels).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from turboprune_tpu.models.vit import VisionTransformer
+from turboprune_tpu.ops.flash import flash_attention
+
+
+def dense_oracle(q, k, v, valid, scale):
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32), k.astype(jnp.float32))
+    s = jnp.where(valid[:, None, :] > 0, s * scale, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32))
+
+
+def make_qkv(bh=4, s=16, d=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return tuple(
+        jnp.asarray(rng.normal(size=(bh, s, d)), jnp.float32) for _ in range(3)
+    )
+
+
+class TestFlashForward:
+    @pytest.mark.parametrize("blocks", [(16, 16), (8, 8), (16, 8), (8, 16)])
+    def test_matches_dense(self, blocks):
+        q, k, v = make_qkv()
+        valid = jnp.ones((1, 16))
+        bq, bk = blocks
+        out = flash_attention(q, k, v, valid, 0.35, bq, bk)
+        ref = dense_oracle(q, k, v, valid, 0.35)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_padding_masked(self):
+        q, k, v = make_qkv(s=16)
+        valid = jnp.asarray([[1.0] * 11 + [0.0] * 5])
+        out = flash_attention(q, k, v, valid, 0.5, 8, 8)
+        ref = dense_oracle(q, k, v, valid, 0.5)
+        np.testing.assert_allclose(
+            np.asarray(out)[:, :11], np.asarray(ref)[:, :11], atol=1e-5
+        )
+
+    def test_bf16_inputs(self):
+        q, k, v = (t.astype(jnp.bfloat16) for t in make_qkv())
+        valid = jnp.ones((1, 16))
+        out = flash_attention(q, k, v, valid, 0.35, 8, 8)
+        assert out.dtype == jnp.bfloat16
+        ref = dense_oracle(q, k, v, valid, 0.35)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref), atol=3e-2
+        )
+
+
+class TestFlashBackward:
+    def test_grads_match_dense(self):
+        q, k, v = make_qkv(bh=2, s=16, d=8)
+        valid = jnp.asarray([[1.0] * 13 + [0.0] * 3])
+        tgt = jnp.asarray(
+            np.random.default_rng(9).normal(size=q.shape), jnp.float32
+        )
+
+        def loss_flash(q, k, v):
+            o = flash_attention(q, k, v, valid, 0.4, 8, 8)
+            return jnp.sum((o * (valid[..., None] > 0) - tgt) ** 2)
+
+        def loss_dense(q, k, v):
+            o = dense_oracle(q, k, v, valid, 0.4)
+            return jnp.sum((o * (valid[..., None] > 0) - tgt) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(gf, gd, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-4, rtol=1e-4,
+                err_msg=f"d{name}",
+            )
+
+
+class TestFlashViT:
+    def tiny(self, impl):
+        return VisionTransformer(
+            num_classes=10, patch_size=4, embed_dim=16, depth=2, num_heads=2,
+            attention_impl=impl,
+        )
+
+    def test_forward_equals_dense_impl(self):
+        dense, flash = self.tiny("dense"), self.tiny("flash")
+        x = jnp.asarray(
+            np.random.default_rng(2).normal(size=(2, 8, 8, 3)), jnp.float32
+        )
+        params = dense.init(jax.random.PRNGKey(0), x)["params"]
+        out_d = dense.apply({"params": params}, x, train=False)
+        out_f = flash.apply({"params": params}, x, train=False)
+        np.testing.assert_allclose(
+            np.asarray(out_f), np.asarray(out_d), atol=1e-4, rtol=1e-4
+        )
+
+    def test_train_grads_flow(self):
+        flash = self.tiny("flash")
+        x = jnp.asarray(
+            np.random.default_rng(3).normal(size=(2, 8, 8, 3)), jnp.float32
+        )
+        params = flash.init(jax.random.PRNGKey(0), x)["params"]
+
+        def loss(p):
+            logits = flash.apply({"params": p}, x, train=False)
+            return jnp.mean(logits**2)
+
+        grads = jax.grad(loss)(params)
+        gq = grads["block0"]["attn"]["query"]["kernel"]
+        assert np.isfinite(np.asarray(gq)).all() and np.abs(gq).max() > 0

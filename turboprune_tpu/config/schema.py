@@ -32,7 +32,7 @@ TRAINING_TYPES = ("imp", "wr", "lrr", "at_init")
 # bfloat16 is the native fast dtype and the recommended default (fp16 has
 # no hardware advantage and a narrower exponent range).
 PRECISIONS = ("bfloat16", "float16", "float32")
-ATTENTION_IMPLS = ("dense", "ring")
+ATTENTION_IMPLS = ("dense", "ring", "flash")
 OPTIMIZERS = ("SGD", "AdamW", "ScheduleFreeSGD")
 SCHEDULERS = (
     "MultiStepLRWarmup",
@@ -124,8 +124,9 @@ class ModelConfig:
     # is staged by the user). Empty = random init. ViT models only.
     pretrained_path: str = ""
     # "ring" = sequence-parallel ring attention over the mesh model axis
-    # (parallel/ring.py); pair with experiment_params.model_parallelism > 1.
-    # ViT models only; params/checkpoints identical to "dense".
+    # (parallel/ring.py; pair with experiment_params.model_parallelism > 1);
+    # "flash" = single-device blockwise Pallas kernel (ops/flash.py).
+    # ViT models only; params/checkpoints identical across all three.
     attention_impl: str = "dense"
 
     def validate(self) -> None:
@@ -142,8 +143,8 @@ class ModelConfig:
             )
         if self.attention_impl != "dense" and not self.model_name.startswith("deit"):
             raise ConfigError(
-                "attention_impl=ring requires a deit_* model "
-                f"(got model_name={self.model_name!r})"
+                f"attention_impl={self.attention_impl} requires a deit_* "
+                f"model (got model_name={self.model_name!r})"
             )
 
 

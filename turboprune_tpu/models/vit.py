@@ -20,6 +20,7 @@ from typing import Any, Optional
 
 import flax.linen as nn
 import jax.numpy as jnp
+import numpy as np
 
 
 class RingSelfAttention(nn.Module):
@@ -71,6 +72,51 @@ class RingSelfAttention(nn.Module):
         )(out)
 
 
+class FlashSelfAttention(nn.Module):
+    """Single-device blockwise (flash) self-attention — the first-party
+    Pallas kernel in ops/flash.py. Same param tree as the dense and ring
+    implementations (query/key/value/out DenseGeneral), so checkpoints,
+    masks, and pruning are implementation-agnostic. The sequence is padded
+    to a block multiple; padded keys are masked out of the softmax and
+    padded query rows are sliced away.
+
+    Attention dropout is not supported (the reference's DeiT configs use
+    attn_drop=0, /root/reference/utils/deit.py)."""
+
+    num_heads: int
+    dtype: Any = jnp.float32
+    block: int = 128
+
+    @nn.compact
+    def __call__(self, x):
+        from ..ops.flash import flash_attention
+
+        n, seq, d = x.shape
+        h = self.num_heads
+        hd = d // h
+        q = nn.DenseGeneral((h, hd), dtype=self.dtype, name="query")(x)
+        k = nn.DenseGeneral((h, hd), dtype=self.dtype, name="key")(x)
+        v = nn.DenseGeneral((h, hd), dtype=self.dtype, name="value")(x)
+
+        pad = (-seq) % self.block
+        s_pad = seq + pad
+        if pad:
+            widths = ((0, 0), (0, pad), (0, 0), (0, 0))
+            q, k, v = (jnp.pad(t, widths) for t in (q, k, v))
+        # [B, S, H, hd] -> [B*H, S, hd] for the kernel's flat batch grid.
+        q, k, v = (
+            t.transpose(0, 2, 1, 3).reshape(n * h, s_pad, hd) for t in (q, k, v)
+        )
+        valid = (jnp.arange(s_pad) < seq)[None, :]
+        out = flash_attention(
+            q, k, v, valid, 1.0 / float(np.sqrt(hd)), self.block, self.block
+        )
+        out = out.reshape(n, h, s_pad, hd).transpose(0, 2, 1, 3)[:, :seq]
+        return nn.DenseGeneral(
+            d, axis=(-2, -1), dtype=self.dtype, name="out"
+        )(out)
+
+
 class MlpBlock(nn.Module):
     hidden_dim: int
     out_dim: int
@@ -93,7 +139,8 @@ class EncoderBlock(nn.Module):
     dropout_rate: float = 0.0
     attn_dropout_rate: float = 0.0
     dtype: Any = jnp.float32
-    attention_impl: str = "dense"  # "dense" | "ring" (sequence-parallel)
+    # "dense" | "ring" (sequence-parallel) | "flash" (Pallas blockwise)
+    attention_impl: str = "dense"
     mesh: Any = None  # required for attention_impl="ring"
 
     @nn.compact
@@ -106,6 +153,10 @@ class EncoderBlock(nn.Module):
                 mesh=self.mesh,
                 dtype=self.dtype,
                 name="attn",
+            )(y)
+        elif self.attention_impl == "flash":
+            y = FlashSelfAttention(
+                num_heads=self.num_heads, dtype=self.dtype, name="attn"
             )(y)
         else:
             y = nn.MultiHeadDotProductAttention(

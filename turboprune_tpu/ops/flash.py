@@ -1,0 +1,267 @@
+"""First-party Pallas TPU flash attention (forward + backward kernels).
+
+Non-causal multi-head attention with a key-validity mask, computed
+blockwise so the S x S score matrix never materializes in HBM: for each
+query block the kernel streams key/value blocks through VMEM, carrying the
+online-softmax running max/sum in VMEM scratch across the (sequential)
+innermost grid dimension — the flash-attention recurrence on the hardware
+it was shaped for (MXU matmuls with fp32 accumulators, VPU for the
+exp/max/sum, ~(BLOCK x BLOCK) live scores).
+
+The backward pass is two more Pallas kernels over the same block grid
+(recompute-based, flash2-style): residuals are just (o, logsumexp), so
+training memory stays O(S) per head instead of O(S^2).
+
+Relationship to the rest of the framework:
+  - models/vit.py wires this as ``attention_impl: "flash"`` — single-device
+    blockwise attention with the SAME param tree as dense/ring.
+  - parallel/ring.py is the multi-device complement (sequence sharded over
+    the mesh, K/V rotating by ppermute); flash is the within-device answer.
+  - The reference has no analog: its DeiT path runs timm's dense attention
+    (materialized scores) and was dead code anyway (SURVEY.md §2.1).
+
+On non-TPU backends the kernels run in Pallas interpret mode (exact same
+program, executed by XLA ops) — which is how the CPU test suite proves
+them, including gradients, against a dense jnp oracle.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_BIG = -1e30
+
+
+def _use_interpret() -> bool:
+    """Mosaic lowering needs a real TPU; anything else runs interpreted."""
+    return jax.default_backend() not in ("tpu",)
+
+
+def _dot(a, b):  # [m, k] @ [k, n] with fp32 accumulation on the MXU
+    return jax.lax.dot_general(
+        a, b, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _dot_t0(a, b):  # contract dim 0 of both: [k, m] x [k, n] -> [m, n]
+    return jax.lax.dot_general(
+        a, b, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _dot_t1(a, b):  # contract dim 1 of both: [m, k] x [n, k] -> [m, n]
+    return jax.lax.dot_general(
+        a, b, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+# ------------------------------------------------------------------ forward
+def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, acc, m, l, *,
+                scale: float):
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+        m[:] = jnp.full_like(m, -jnp.inf)
+        l[:] = jnp.zeros_like(l)
+
+    q = q_ref[0]  # [Bq, D]
+    k = k_ref[0]  # [Bk, D]
+    valid = mask_ref[0] > 0  # [Bk]
+    s = _dot_t1(q.astype(jnp.float32) * scale, k.astype(jnp.float32))
+    s = jnp.where(valid[None, :], s, NEG_BIG)
+
+    m_old = m[:]  # [Bq, 1]
+    m_new = jnp.maximum(m_old, s.max(axis=1, keepdims=True))
+    p = jnp.exp(s - m_new) * valid[None, :]
+    corr = jnp.exp(m_old - m_new)
+    l[:] = l[:] * corr + p.sum(axis=1, keepdims=True)
+    acc[:] = acc[:] * corr + _dot(p.astype(v_ref.dtype), v_ref[0])
+    m[:] = m_new
+
+    @pl.when(ki == nk - 1)
+    def _():
+        lsafe = jnp.maximum(l[:], 1e-30)
+        o_ref[0] = (acc[:] / lsafe).astype(o_ref.dtype)
+        lse_ref[0] = m[:] + jnp.log(lsafe)
+
+
+def _flash_fwd(q, k, v, mask, scale, block_q, block_k, interpret):
+    bh, s_len, d = q.shape
+    nq, nk = s_len // block_q, s_len // block_k
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_k), lambda b, qi, ki: (0, ki)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_len, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s_len, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, mask)
+    return o, lse
+
+
+# ----------------------------------------------------------------- backward
+def _dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, drow_ref,
+               dq_ref, dq_acc, *, scale: float):
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    valid = mask_ref[0] > 0
+    s = _dot_t1(q * scale, k)
+    s = jnp.where(valid[None, :], s, NEG_BIG)
+    p = jnp.exp(s - lse_ref[0]) * valid[None, :]  # [Bq, Bk]
+    dp = _dot_t1(do_ref[0].astype(jnp.float32), v_ref[0].astype(jnp.float32))
+    ds = p * (dp - drow_ref[0]) * scale  # [Bq, Bk]
+    dq_acc[:] = dq_acc[:] + _dot(ds, k)
+
+    @pl.when(ki == nk - 1)
+    def _():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, drow_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float):
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    valid = mask_ref[0] > 0
+    s = _dot_t1(q * scale, k)
+    s = jnp.where(valid[None, :], s, NEG_BIG)
+    p = jnp.exp(s - lse_ref[0]) * valid[None, :]  # [Bq, Bk]
+    dv_acc[:] = dv_acc[:] + _dot_t0(p, do)  # [Bk, D]
+    dp = _dot_t1(do, v_ref[0].astype(jnp.float32))
+    ds = p * (dp - drow_ref[0]) * scale
+    dk_acc[:] = dk_acc[:] + _dot_t0(ds, q)  # [Bk, D]
+
+    @pl.when(qi == nq - 1)
+    def _():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+# ------------------------------------------------------------------- public
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7)
+)
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    kv_valid: jax.Array,
+    scale: float,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Blockwise (flash) attention. q/k/v: [batch*heads, seq, head_dim];
+    ``block_q``/``block_k`` must divide ``seq`` (pad the sequence up to a
+    block multiple first — models/vit.py FlashSelfAttention does). kv_valid:
+    [1, seq] (0/1) marking real key rows. Returns the same shape as q."""
+    o, _ = _fa_fwd(q, k, v, kv_valid, scale, block_q, block_k, interpret)
+    return o
+
+
+def _fa_fwd(q, k, v, kv_valid, scale, block_q, block_k, interpret):
+    if interpret is None:
+        interpret = _use_interpret()
+    mask = kv_valid.astype(jnp.float32)
+    o, lse = _flash_fwd(q, k, v, mask, scale, block_q, block_k, interpret)
+    return o, (q, k, v, mask, o, lse)
+
+
+def _fa_bwd(scale, block_q, block_k, interpret, residuals, g):
+    if interpret is None:
+        interpret = _use_interpret()
+    q, k, v, mask, o, lse = residuals
+    bh, s_len, d = q.shape
+    nq, nk = s_len // block_q, s_len // block_k
+    # D_i = sum_d do * o — per (row) softmax-derivative correction term.
+    drow = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
+                   keepdims=True)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_k), lambda b, qi, ki: (0, ki)),
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, mask, g, lse, drow)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_k), lambda b, ki, qi: (0, ki)),
+            pl.BlockSpec((1, block_q, d), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, ki, qi: (b, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, mask, g, lse, drow)
+    return dq, dk, dv, None
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)

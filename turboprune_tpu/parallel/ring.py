@@ -23,7 +23,15 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.experimental.shard_map import shard_map
+
+try:  # jax >= 0.8 top-level API; experimental path for older versions
+    from jax import shard_map
+
+    _CHECK_KW = {"check_vma": False}
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+    _CHECK_KW = {"check_rep": False}  # legacy name of the same knob
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .mesh import DATA_AXIS, MODEL_AXIS
@@ -118,6 +126,6 @@ def ring_attention(
         mesh=mesh,
         in_specs=(spec, spec, spec, P(seq_axis)),
         out_specs=spec,
-        check_rep=False,
+        **_CHECK_KW,
     )
     return fn(q, k, v, kv_valid)
