@@ -102,6 +102,15 @@ class TestTwoProcessWorld:
             mp_results[0]["imp_fingerprint"] == mp_results[1]["imp_fingerprint"]
         )
 
+    def test_ring_attention_cross_host_identical(self, mp_results):
+        # shard_map ring attention over a mesh spanning both processes:
+        # the ppermute ring crosses the process boundary and the replicated
+        # output must agree bit-for-bit.
+        assert (
+            mp_results[0]["ring_mp_fingerprint"]
+            == mp_results[1]["ring_mp_fingerprint"]
+        )
+
     def test_snip_host_scope_consistent(self, mp_results):
         # SNIP scored on a host-scope loader: masks and the scoring batch
         # itself must be identical across hosts (the r3 divergence defect).

@@ -100,6 +100,43 @@ class TestJpegMode:
         assert not np.array_equal(a, c)
         f.close()
 
+    def test_scaled_decode_large_source(self, tmp_path):
+        """Large sources take the reduced-resolution DCT decode path
+        (scale 1/2^k when the crop is >= 2x the output) — the result must
+        stay close to a full-resolution PIL decode+crop+resize and remain
+        deterministic. A 320px source with a 32px output forces denom > 1
+        on both the eval center crop (280px) and most train crops."""
+        from PIL import Image
+
+        rng = np.random.default_rng(3)
+        # Smooth low-frequency image: scaled DCT decode approximates the
+        # full-res downscale closely on smooth content (noise images would
+        # alias differently and blow the tolerance for reasons unrelated to
+        # correctness).
+        small = rng.integers(0, 256, size=(10, 10, 3), dtype=np.uint8)
+        arr = np.asarray(
+            Image.fromarray(small).resize((320, 320), Image.BILINEAR), np.uint8
+        )
+        buf = io.BytesIO()
+        Image.fromarray(arr).save(buf, format="JPEG", quality=95)
+        path = tmp_path / "big.tpk"
+        write_tpk_jpegs(path, [buf.getvalue()], np.zeros(1, np.int32))
+        f = TpkFile(path)
+        got, _ = f.decode(np.zeros(1, np.int64), 32, train=False, nthreads=1)
+        ref = Image.open(io.BytesIO(buf.getvalue())).convert("RGB")
+        c = int(round(224 / 256 * 320))
+        x = (320 - c) // 2
+        ref = np.asarray(
+            ref.resize((32, 32), Image.BILINEAR, box=(x, x, x + c, x + c)),
+            np.int32,
+        )
+        diff = np.abs(got[0].astype(np.int32) - ref).mean()
+        assert diff < 8.0, f"mean abs diff {diff}"
+        a, _ = f.decode(np.zeros(4, np.int64), 32, train=True, seed=5, nthreads=4)
+        b, _ = f.decode(np.zeros(4, np.int64), 32, train=True, seed=5, nthreads=1)
+        np.testing.assert_array_equal(a, b)
+        f.close()
+
 
 class TestLoader:
     def test_pack_imagefolder_and_iterate(self, tmp_path):

@@ -23,16 +23,42 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _project_qkv_padded(x, num_heads: int, dtype, multiple: int):
+    """Shared scaffolding for the ring/flash attention modules: q/k/v
+    DenseGeneral projections with the EXACT param names flax's
+    MultiHeadDotProductAttention uses (query/key/value, kernel (D, H, D/H))
+    — the contract that makes checkpoints, masks, and the pruning predicate
+    (ops/masking.py:31-39) interchangeable across attention
+    implementations — plus padding of the token axis to ``multiple``.
+    Must be called from inside an ``@nn.compact`` body (the Dense modules
+    attach to the caller's scope). Returns (q, k, v, seq) with
+    [B, S_pad, H, D/H] tensors."""
+    d = x.shape[-1]
+    hd = d // num_heads
+    q = nn.DenseGeneral((num_heads, hd), dtype=dtype, name="query")(x)
+    k = nn.DenseGeneral((num_heads, hd), dtype=dtype, name="key")(x)
+    v = nn.DenseGeneral((num_heads, hd), dtype=dtype, name="value")(x)
+    seq = x.shape[1]
+    pad = (-seq) % multiple
+    if pad:
+        widths = ((0, 0), (0, pad), (0, 0), (0, 0))
+        q, k, v = (jnp.pad(t, widths) for t in (q, k, v))
+    return q, k, v, seq
+
+
+def _project_out(out, d: int, dtype):
+    """The output projection (flax MHA's ``out`` DenseGeneral, (H, D/H, D))."""
+    return nn.DenseGeneral(d, axis=(-2, -1), dtype=dtype, name="out")(out)
+
+
 class RingSelfAttention(nn.Module):
     """Sequence-parallel self-attention (ring attention over the mesh
     ``model`` axis, parallel/ring.py).
 
     Drop-in replacement for ``nn.MultiHeadDotProductAttention`` with an
-    IDENTICAL param tree (query/key/value DenseGeneral (D, H, D/H) + out
-    DenseGeneral (H, D/H, D)), so checkpoints, masks, and the pruning
-    predicate (ops/masking.py:31-39) are interchangeable between the dense
-    and ring implementations. Sequences that don't divide the ring size are
-    padded here and the padding masked out of the softmax.
+    IDENTICAL param tree (see _project_qkv_padded). Sequences that don't
+    divide the ring size are padded and the padding masked out of the
+    softmax.
 
     Attention dropout is not supported on the ring path (the reference's
     DeiT configs use attn_drop=0 anyway, /root/reference/utils/deit.py).
@@ -52,33 +78,20 @@ class RingSelfAttention(nn.Module):
                 "attention_impl='ring' needs a mesh (create_model(..., "
                 "mesh=...) — the harness passes its own)"
             )
-        d = x.shape[-1]
-        h = self.num_heads
-        hd = d // h
-        q = nn.DenseGeneral((h, hd), dtype=self.dtype, name="query")(x)
-        k = nn.DenseGeneral((h, hd), dtype=self.dtype, name="key")(x)
-        v = nn.DenseGeneral((h, hd), dtype=self.dtype, name="value")(x)
-
-        seq = x.shape[1]
-        ring = self.mesh.shape[MODEL_AXIS]
-        pad = (-seq) % ring
-        if pad:
-            widths = ((0, 0), (0, pad), (0, 0), (0, 0))
-            q, k, v = (jnp.pad(t, widths) for t in (q, k, v))
-        valid = jnp.arange(seq + pad) < seq
+        q, k, v, seq = _project_qkv_padded(
+            x, self.num_heads, self.dtype, self.mesh.shape[MODEL_AXIS]
+        )
+        valid = jnp.arange(q.shape[1]) < seq
         out = ring_attention(q, k, v, valid, self.mesh)[:, :seq]
-        return nn.DenseGeneral(
-            d, axis=(-2, -1), dtype=self.dtype, name="out"
-        )(out)
+        return _project_out(out, x.shape[-1], self.dtype)
 
 
 class FlashSelfAttention(nn.Module):
     """Single-device blockwise (flash) self-attention — the first-party
     Pallas kernel in ops/flash.py. Same param tree as the dense and ring
-    implementations (query/key/value/out DenseGeneral), so checkpoints,
-    masks, and pruning are implementation-agnostic. The sequence is padded
-    to a block multiple; padded keys are masked out of the softmax and
-    padded query rows are sliced away.
+    implementations (see _project_qkv_padded). The sequence is padded to a
+    block multiple; padded keys are masked out of the softmax and padded
+    query rows are sliced away.
 
     Attention dropout is not supported (the reference's DeiT configs use
     attn_drop=0, /root/reference/utils/deit.py)."""
@@ -91,18 +104,11 @@ class FlashSelfAttention(nn.Module):
     def __call__(self, x):
         from ..ops.flash import flash_attention
 
-        n, seq, d = x.shape
+        n, _, d = x.shape
         h = self.num_heads
         hd = d // h
-        q = nn.DenseGeneral((h, hd), dtype=self.dtype, name="query")(x)
-        k = nn.DenseGeneral((h, hd), dtype=self.dtype, name="key")(x)
-        v = nn.DenseGeneral((h, hd), dtype=self.dtype, name="value")(x)
-
-        pad = (-seq) % self.block
-        s_pad = seq + pad
-        if pad:
-            widths = ((0, 0), (0, pad), (0, 0), (0, 0))
-            q, k, v = (jnp.pad(t, widths) for t in (q, k, v))
+        q, k, v, seq = _project_qkv_padded(x, h, self.dtype, self.block)
+        s_pad = q.shape[1]
         # [B, S, H, hd] -> [B*H, S, hd] for the kernel's flat batch grid.
         q, k, v = (
             t.transpose(0, 2, 1, 3).reshape(n * h, s_pad, hd) for t in (q, k, v)
@@ -112,9 +118,7 @@ class FlashSelfAttention(nn.Module):
             q, k, v, valid, 1.0 / float(np.sqrt(hd)), self.block, self.block
         )
         out = out.reshape(n, h, s_pad, hd).transpose(0, 2, 1, 3)[:, :seq]
-        return nn.DenseGeneral(
-            d, axis=(-2, -1), dtype=self.dtype, name="out"
-        )(out)
+        return _project_out(out, d, self.dtype)
 
 
 class MlpBlock(nn.Module):

@@ -1,4 +1,5 @@
 from . import masking
+from .flash import flash_attention
 from .masking import (
     apply_masks,
     global_threshold_mask,
@@ -18,6 +19,7 @@ from .masking import (
 
 __all__ = [
     "masking",
+    "flash_attention",
     "apply_masks",
     "global_threshold_mask",
     "is_prunable_path",

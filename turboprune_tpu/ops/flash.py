@@ -200,6 +200,19 @@ def flash_attention(
 
 
 def _fa_fwd(q, k, v, kv_valid, scale, block_q, block_k, interpret):
+    s_len = q.shape[1]
+    if s_len % block_q or s_len % block_k:
+        raise ValueError(
+            f"flash_attention: seq {s_len} must be a multiple of "
+            f"block_q={block_q} and block_k={block_k} — pad the sequence "
+            "(the grid floor-divides and would silently drop the tail)"
+        )
+    if kv_valid.shape != (1, s_len):
+        raise ValueError(
+            f"flash_attention: kv_valid must have shape (1, {s_len}), got "
+            f"{kv_valid.shape} — the mask is shared across the batch "
+            "(a per-example mask would be silently ignored)"
+        )
     if interpret is None:
         interpret = _use_interpret()
     mask = kv_valid.astype(jnp.float32)
