@@ -256,16 +256,65 @@ def _log(msg: str) -> None:
 _T0 = time.monotonic()
 
 
+_watchdog = None
+_partial: dict = {}  # stage results gathered so far, reported if we stall
+
+
+def _arm_watchdog(seconds: int = 480) -> None:
+    """The axon TPU tunnel sometimes stalls so hard that a device op (or
+    jax.devices() itself) blocks forever; the try/excepts below catch
+    exceptions, not hangs, so without this the bench would hang and the
+    round would record NO result at all. Re-armed after every stage: if the
+    CURRENT stage hasn't finished within ``seconds``, emit whatever was
+    already measured as the result line (with an error marker) and exit."""
+    import threading
+
+    global _watchdog
+    if _watchdog is not None:
+        _watchdog.cancel()
+
+    def fire():
+        extra = dict(_partial.get("extra", {}))
+        extra["error"] = (
+            f"watchdog: stage exceeded {seconds}s — TPU tunnel unresponsive; "
+            "reporting partial results"
+        )
+        value = _partial.get("img_r18", 0.0)
+        print(
+            json.dumps(
+                {
+                    "metric": "resnet18_imagenet224_train_throughput_1chip",
+                    "value": round(value, 1),
+                    "unit": "img/s",
+                    "vs_baseline": round(value / BASELINE_IMG_PER_SEC_PER_CHIP, 3),
+                    "extra": extra,
+                }
+            ),
+            flush=True,
+        )
+        os._exit(3)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    _watchdog = t
+
+
 def main() -> None:
     extra: dict = {}
+    _partial["extra"] = extra
 
+    _arm_watchdog()
     _log("resnet18 train bench...")
     img_r18, _ = bench_train("resnet18", BATCH_R18)
+    _partial["img_r18"] = img_r18
+    _arm_watchdog()
     _log(f"resnet18 {img_r18:.0f} img/s")
 
     try:
         _log("resnet50 train bench...")
         img_r50, flops_r50 = bench_train("resnet50", BATCH_R50)
+        _arm_watchdog()
         _log(f"resnet50 {img_r50:.0f} img/s")
         extra["resnet50_img_per_sec"] = round(img_r50, 1)
         if flops_r50:
@@ -288,8 +337,10 @@ def main() -> None:
         split = _ensure_jpeg_dataset(root)
         _log("tpk decode bench...")
         extra["tpk_decode_img_per_sec"] = round(bench_tpk_decode(split, root), 1)
+        _arm_watchdog()
         _log(f"tpk {extra['tpk_decode_img_per_sec']} img/s; grain decode bench...")
         extra["grain_decode_img_per_sec"] = round(bench_grain_decode(split), 1)
+        _arm_watchdog()
         _log(f"grain {extra['grain_decode_img_per_sec']} img/s; fed resnet50...")
         extra["resnet50_fed_img_per_sec"] = round(
             bench_fed_resnet50(split, root), 1
@@ -300,6 +351,7 @@ def main() -> None:
         extra["pipeline_error"] = repr(e)[:200]
         _log(f"pipeline error: {e!r}")
 
+    _watchdog.cancel()  # final print below is unconditional
     print(
         json.dumps(
             {
