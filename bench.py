@@ -274,6 +274,8 @@ def _arm_watchdog(seconds: int = 480) -> None:
         _watchdog.cancel()
 
     def fire():
+        if _partial.get("done"):
+            return  # lost the race with the final print — not a stall
         extra = dict(_partial.get("extra", {}))
         extra["error"] = (
             f"watchdog: stage exceeded {seconds}s — TPU tunnel unresponsive; "
@@ -331,10 +333,12 @@ def main() -> None:
         extra["resnet50_error"] = repr(e)[:200]
 
     try:
+        _arm_watchdog()  # fresh window regardless of how resnet50 ended
         root = Path(os.environ.get("BENCH_DATA_DIR", "/tmp/turboprune_bench"))
         root.mkdir(parents=True, exist_ok=True)
         _log("jpeg dataset...")
         split = _ensure_jpeg_dataset(root)
+        _arm_watchdog()
         _log("tpk decode bench...")
         extra["tpk_decode_img_per_sec"] = round(bench_tpk_decode(split, root), 1)
         _arm_watchdog()
@@ -351,7 +355,8 @@ def main() -> None:
         extra["pipeline_error"] = repr(e)[:200]
         _log(f"pipeline error: {e!r}")
 
-    _watchdog.cancel()  # final print below is unconditional
+    _partial["done"] = True  # fire() checks this — cancel can lose the race
+    _watchdog.cancel()
     print(
         json.dumps(
             {

@@ -189,6 +189,66 @@ def test_load_from_file_deit_wrapper(tmp_path):
         load_pretrained(tmp_path / "nope.pth", model, params)
 
 
+def test_harness_warm_starts_from_pretrained(tmp_path):
+    """The harness applies pretrained_path to the fresh init (before any
+    level-0 artifact is saved) — the registry deit_tiny's weights must
+    equal the staged checkpoint after PruningHarness construction."""
+    # deit_tiny_patch16_224 geometry at 32px CIFAR input: (32/16)^2+1 tokens.
+    D, DEPTH, HEADS, PS = 192, 12, 3, 16
+    g = torch.Generator().manual_seed(3)
+    r = lambda *s: torch.randn(*s, generator=g) * 0.05
+    sd = {
+        "cls_token": r(1, 1, D),
+        "pos_embed": r(1, 5, D),
+        "patch_embed.proj.weight": r(D, 3, PS, PS),
+        "patch_embed.proj.bias": r(D),
+        "norm.weight": 1 + 0.05 * r(D),
+        "norm.bias": r(D),
+        "head.weight": r(10, D),
+        "head.bias": r(10),
+    }
+    for i in range(DEPTH):
+        b = f"blocks.{i}"
+        sd.update(
+            {
+                f"{b}.norm1.weight": 1 + 0.05 * r(D), f"{b}.norm1.bias": r(D),
+                f"{b}.attn.qkv.weight": r(3 * D, D), f"{b}.attn.qkv.bias": r(3 * D),
+                f"{b}.attn.proj.weight": r(D, D), f"{b}.attn.proj.bias": r(D),
+                f"{b}.norm2.weight": 1 + 0.05 * r(D), f"{b}.norm2.bias": r(D),
+                f"{b}.mlp.fc1.weight": r(4 * D, D), f"{b}.mlp.fc1.bias": r(4 * D),
+                f"{b}.mlp.fc2.weight": r(D, 4 * D), f"{b}.mlp.fc2.bias": r(D),
+            }
+        )
+    ckpt = tmp_path / "deit_tiny.pth"
+    torch.save({"model": sd}, ckpt)
+
+    from turboprune_tpu.config.compose import compose
+    from turboprune_tpu.harness import PruningHarness
+
+    cfg = compose(
+        "cifar10_imp",
+        overrides=[
+            "model_params.model_name=deit_tiny_patch16_224",
+            f"model_params.pretrained_path={ckpt}",
+            "dataset_params.dataloader_type=synthetic",
+            "dataset_params.total_batch_size=8",
+            "dataset_params.synthetic_num_train=16",
+            "dataset_params.synthetic_num_test=8",
+            f"experiment_params.base_dir={tmp_path}",
+        ],
+    )
+    harness = PruningHarness(cfg, ("t", str(tmp_path / "expt")))
+    got = np.asarray(jax.device_get(harness.state.params["cls_token"]))
+    np.testing.assert_allclose(got, sd["cls_token"].numpy(), atol=1e-6)
+    got_q = np.asarray(
+        jax.device_get(harness.state.params["block0"]["attn"]["query"]["kernel"])
+    )
+    want_q = (
+        sd["blocks.0.attn.qkv.weight"][:D].numpy().T.reshape(D, HEADS, D // HEADS)
+    )
+    np.testing.assert_allclose(got_q, want_q, atol=1e-6)
+
+
 def test_config_rejects_pretrained_on_cnn():
     from turboprune_tpu.config.schema import ConfigError, config_from_dict
 
