@@ -4,7 +4,7 @@
 Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", "extra"}.
 The headline metric stays ResNet18 ImageNet-shape training throughput on
 one chip (round-to-round continuity); ``extra`` carries the north-star
-numbers VERDICT r3 asked for:
+numbers VERDICT r3/r4 asked for:
 
   resnet50_img_per_sec     ResNet50/224 bs512 train throughput, one chip
                            (the reference's actual recipe batch,
@@ -16,7 +16,19 @@ numbers VERDICT r3 asked for:
                            (decode -> host uint8 batch; device transfer
                            excluded — see _steady_epochs for why)
   resnet50_fed_img_per_sec ResNet50 step throughput with the tpk pipeline
-                           actually feeding (decode + transfer + train)
+                           actually feeding (decode + transfer + train),
+                           at the recipe batch 512
+  flash_fwdbwd_ms /        Pallas flash attention fwd+bwd wall time and
+  flash_vs_dense_speedup   speedup vs dense-softmax attention, REAL chip
+                           (proves Mosaic lowering outside interpret mode)
+
+Stage persistence (VERDICT r4 weak #2): each stage's fields are written to
+``$BENCH_DATA_DIR/stages.json`` the moment they are measured; a rerun skips
+stages already captured (set BENCH_FORCE=1 to re-measure), and the watchdog
+reports everything accumulated so far. A flaky-tunnel day therefore still
+converges to a complete BENCH record across attempts, and the final print
+labels which fields came from the cache (``cached_stages`` + per-stage
+timestamps) so the artifact stays honest about when each number was taken.
 
 Baseline: the reference's only published number — ResNet18/ImageNet at
 1:09 min/epoch on 4x A100 with FFCV (/root/reference/README.md:8) =
@@ -41,6 +53,7 @@ import json
 import os
 import sys
 import time
+from datetime import datetime, timezone
 from pathlib import Path
 
 import jax
@@ -49,6 +62,7 @@ import numpy as np
 
 BATCH_R18 = 1024
 BATCH_R50 = 512
+BATCH_FED = 512  # recipe batch (BASELINE.md) — was 256 pre-r5
 WARMUP_STEPS = 3
 STEPS_PER_ROUND = 10
 ROUNDS = 3
@@ -165,7 +179,8 @@ def _steady_epochs(epoch_fn, epochs: int = 3) -> float:
     """img/s over epochs 2..N — epoch 1 is discarded as warmup. Measuring a
     single short epoch flatters prefetching loaders (workers decode the
     whole tail during the first batch's latency), so the rate must be taken
-    at steady state.
+    at steady state. ``epoch_fn(e)`` receives the epoch index so loaders can
+    derive fresh per-epoch augmentation seeds.
 
     Both decode benches measure the HOST pipeline (decode -> host uint8
     batch). The device transfer is deliberately excluded: on this axon
@@ -177,7 +192,7 @@ def _steady_epochs(epoch_fn, epochs: int = 3) -> float:
     n, t = 0, 0.0
     for e in range(epochs):
         t0 = time.perf_counter()
-        count = epoch_fn()
+        count = epoch_fn(e)
         dt = time.perf_counter() - t0
         if e > 0:
             n += count
@@ -194,13 +209,18 @@ def bench_tpk_decode(split: Path, root: Path, batch: int = 256) -> float:
     f = TpkFile(tpk)
     rng = np.random.default_rng(0)
     nthreads = min(16, os.cpu_count() or 1)
+    steps = f.num_samples // batch
 
-    def one_epoch() -> int:
+    def one_epoch(e: int) -> int:
         order = rng.permutation(f.num_samples).astype(np.int64)
         count = 0
-        for b in range(f.num_samples // batch):
+        for b in range(steps):
             idx = order[b * batch : (b + 1) * batch]
-            images, _ = f.decode(idx, 224, train=True, seed=b, nthreads=nthreads)
+            # Seed from (epoch, batch) so steady-state epochs decode FRESH
+            # random crops, like real training, instead of replaying epoch 1.
+            images, _ = f.decode(
+                idx, 224, train=True, seed=e * steps + b, nthreads=nthreads
+            )
             count += images.shape[0]
         return count
 
@@ -216,15 +236,16 @@ def bench_grain_decode(split: Path, batch: int = 256, workers: int = 2) -> float
         str(split), total_batch_size=batch, train=True, num_workers=workers
     )
 
-    def one_epoch() -> int:
+    def one_epoch(e: int) -> int:
         return sum(images.shape[0] for images, _ in loader._raw_batches())
 
     return _steady_epochs(one_epoch)
 
 
-def bench_fed_resnet50(split: Path, root: Path, batch: int = 256) -> float:
+def bench_fed_resnet50(split: Path, root: Path, batch: int = BATCH_FED) -> float:
     """ResNet50 steps with the tpk pipeline actually feeding — the honest
-    epoch-wall-clock shape (BASELINE.md's 69 s/epoch includes FFCV decode)."""
+    epoch-wall-clock shape (BASELINE.md's 69 s/epoch includes FFCV decode),
+    at the recipe batch (512, dp_imagenet_ffcv.yaml)."""
     from turboprune_tpu.data.native import TpkImageLoader
 
     step, state, warm_batch = _make_step("resnet50", batch)
@@ -249,6 +270,67 @@ def bench_fed_resnet50(split: Path, root: Path, batch: int = 256) -> float:
     return n / t
 
 
+# ------------------------------------------------------- flash attention
+def bench_flash_attention() -> dict:
+    """Pallas flash vs dense attention, fwd+bwd, on the REAL chip — the
+    committed proof that Mosaic lowering works outside interpret mode
+    (VERDICT r4 missing #5). deit_small-shaped heads (6 x 64) at S=1024,
+    batch 8 -> [48, 1024, 64]."""
+    if jax.default_backend() != "tpu":
+        raise RuntimeError("flash bench requires the real TPU backend")
+    from turboprune_tpu.ops.flash import flash_attention
+
+    bh, s_len, d = 48, 1024, 64
+    scale = d**-0.5
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (
+        jax.random.normal(key, (bh, s_len, d), jnp.bfloat16) for key in ks
+    )
+    valid = jnp.ones((1, s_len), jnp.float32)
+
+    def flash_loss(q, k, v):
+        o = flash_attention(q, k, v, valid, scale, interpret=False)
+        return o.astype(jnp.float32).sum()
+
+    def dense_loss(q, k, v):
+        # bf16 operands + fp32 accumulation — the SAME numeric contract as
+        # the model's dense attention path and the flash kernel, so the
+        # speedup is measured against the program flash actually replaces
+        # (an fp32-upcast baseline would run off the bf16 MXU path and
+        # flatter the kernel).
+        s = jnp.einsum(
+            "bqd,bkd->bqk", q * scale, k,
+            preferred_element_type=jnp.float32,
+        )
+        p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+        out = jnp.einsum(
+            "bqk,bkd->bqd", p, v, preferred_element_type=jnp.float32
+        )
+        return out.sum()
+
+    def timed(loss_fn) -> float:
+        g = jax.jit(jax.grad(loss_fn, argnums=(0, 1, 2)))
+        dq, _, _ = g(q, k, v)
+        float(dq[0, 0, 0])  # compile + real sync (value fetch)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(10):
+                dq, dk, dv = g(q, k, v)
+            float(dq[0, 0, 0])
+            best = min(best, (time.perf_counter() - t0) / 10)
+        return best
+
+    t_flash = timed(flash_loss)
+    t_dense = timed(dense_loss)
+    return {
+        "flash_fwdbwd_ms": round(t_flash * 1e3, 3),
+        "dense_fwdbwd_ms": round(t_dense * 1e3, 3),
+        "flash_vs_dense_speedup": round(t_dense / t_flash, 3),
+        "flash_shape": f"bh{bh}xS{s_len}xD{d}",
+    }
+
+
 def _log(msg: str) -> None:
     print(f"[bench +{time.monotonic() - _T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
 
@@ -266,7 +348,8 @@ def _arm_watchdog(seconds: int = 480) -> None:
     exceptions, not hangs, so without this the bench would hang and the
     round would record NO result at all. Re-armed after every stage: if the
     CURRENT stage hasn't finished within ``seconds``, emit whatever was
-    already measured as the result line (with an error marker) and exit."""
+    already measured (including stage-cache contents) as the result line
+    (with an error marker) and exit."""
     import threading
 
     global _watchdog
@@ -302,58 +385,120 @@ def _arm_watchdog(seconds: int = 480) -> None:
     _watchdog = t
 
 
+# ------------------------------------------------------- stage persistence
+def _load_stage_cache(path: Path) -> dict:
+    try:
+        return json.loads(path.read_text())
+    except Exception:
+        return {}
+
+
+def _save_stage(path: Path, cache: dict, name: str, fields: dict) -> None:
+    cache[name] = {
+        "fields": fields,
+        "ts": datetime.now(timezone.utc).isoformat(timespec="seconds"),
+    }
+    tmp = path.with_suffix(".tmp")
+    tmp.write_text(json.dumps(cache, indent=1))
+    tmp.replace(path)
+
+
 def main() -> None:
+    root = Path(os.environ.get("BENCH_DATA_DIR", "/tmp/turboprune_bench"))
+    root.mkdir(parents=True, exist_ok=True)
+    cache_path = root / "stages.json"
+    force = bool(os.environ.get("BENCH_FORCE"))
+    # `cache` is what gets persisted: ALWAYS seeded from disk, so a forced
+    # rerun that stalls mid-run cannot clobber stages it never re-reached.
+    # BENCH_FORCE only stops run_stage from REUSING the old values.
+    cache = _load_stage_cache(cache_path)
+    hits = {} if force else cache
+
     extra: dict = {}
+    cached_stages: dict = {}  # name -> capture timestamp
     _partial["extra"] = extra
 
-    _arm_watchdog()
-    _log("resnet18 train bench...")
-    img_r18, _ = bench_train("resnet18", BATCH_R18)
-    _partial["img_r18"] = img_r18
-    _arm_watchdog()
-    _log(f"resnet18 {img_r18:.0f} img/s")
-
-    try:
-        _log("resnet50 train bench...")
-        img_r50, flops_r50 = bench_train("resnet50", BATCH_R50)
+    def run_stage(name: str, fn) -> dict | None:
+        """fn() -> dict of extra fields. Cached stages are reused (with
+        their original timestamp surfaced); fresh results are persisted the
+        moment they land so a later stall can't lose them."""
+        hit = hits.get(name)
+        if hit:
+            extra.update(hit["fields"])
+            cached_stages[name] = hit["ts"]
+            extra["cached_stages"] = cached_stages
+            _log(f"{name}: cached from {hit['ts']}")
+            return hit["fields"]
         _arm_watchdog()
-        _log(f"resnet50 {img_r50:.0f} img/s")
-        extra["resnet50_img_per_sec"] = round(img_r50, 1)
-        if flops_r50:
-            achieved = img_r50 / BATCH_R50 * flops_r50 / 1e12
-            extra["resnet50_tflops_per_sec"] = round(achieved, 1)
+        _log(f"{name}...")
+        try:
+            fields = fn()
+        except Exception as e:
+            extra[f"{name}_error"] = repr(e)[:200]
+            _log(f"{name} error: {e!r}")
+            return None
+        _save_stage(cache_path, cache, name, fields)
+        extra.update(fields)
+        _log(f"{name} done: {fields}")
+        return fields
+
+    _arm_watchdog()
+
+    def stage_r18() -> dict:
+        img, _ = bench_train("resnet18", BATCH_R18)
+        return {"resnet18_img_per_sec": round(img, 1)}
+
+    r18 = run_stage("resnet18", stage_r18)
+    img_r18 = (r18 or {}).get("resnet18_img_per_sec", 0.0)
+    _partial["img_r18"] = img_r18
+
+    def stage_r50() -> dict:
+        img, flops = bench_train("resnet50", BATCH_R50)
+        fields = {
+            "resnet50_img_per_sec": round(img, 1),
+            "resnet50_vs_baseline_per_chip": round(
+                img / BASELINE_IMG_PER_SEC_PER_CHIP, 3
+            ),
+        }
+        if flops:
+            achieved = img / BATCH_R50 * flops / 1e12
+            fields["resnet50_tflops_per_sec"] = round(achieved, 1)
             peak = _detect_peak_tflops()
             if peak:
-                extra["resnet50_mfu"] = round(achieved / peak, 3)
-                extra["chip_peak_tflops"] = peak
-        extra["resnet50_vs_baseline_per_chip"] = round(
-            img_r50 / BASELINE_IMG_PER_SEC_PER_CHIP, 3
-        )
-    except Exception as e:  # never lose the headline number
-        extra["resnet50_error"] = repr(e)[:200]
+                fields["resnet50_mfu"] = round(achieved / peak, 3)
+                fields["chip_peak_tflops"] = peak
+        return fields
 
-    try:
-        _arm_watchdog()  # fresh window regardless of how resnet50 ended
-        root = Path(os.environ.get("BENCH_DATA_DIR", "/tmp/turboprune_bench"))
-        root.mkdir(parents=True, exist_ok=True)
-        _log("jpeg dataset...")
-        split = _ensure_jpeg_dataset(root)
-        _arm_watchdog()
-        _log("tpk decode bench...")
-        extra["tpk_decode_img_per_sec"] = round(bench_tpk_decode(split, root), 1)
-        _arm_watchdog()
-        _log(f"tpk {extra['tpk_decode_img_per_sec']} img/s; grain decode bench...")
-        extra["grain_decode_img_per_sec"] = round(bench_grain_decode(split), 1)
-        _arm_watchdog()
-        _log(f"grain {extra['grain_decode_img_per_sec']} img/s; fed resnet50...")
-        extra["resnet50_fed_img_per_sec"] = round(
-            bench_fed_resnet50(split, root), 1
-        )
-        _log("pipeline benches done")
-        extra["pipeline_host_cpu_cores"] = os.cpu_count()
-    except Exception as e:
-        extra["pipeline_error"] = repr(e)[:200]
-        _log(f"pipeline error: {e!r}")
+    run_stage("resnet50", stage_r50)
+    run_stage("flash_attention", bench_flash_attention)
+
+    # Host-pipeline stages share the JPEG dataset; build it lazily only if
+    # at least one of them is not already cached.
+    _split: list[Path] = []
+
+    def split_dir() -> Path:
+        if not _split:
+            _arm_watchdog()
+            _log("jpeg dataset...")
+            _split.append(_ensure_jpeg_dataset(root))
+        return _split[0]
+
+    def stage_tpk() -> dict:
+        return {"tpk_decode_img_per_sec": round(bench_tpk_decode(split_dir(), root), 1)}
+
+    def stage_grain() -> dict:
+        return {"grain_decode_img_per_sec": round(bench_grain_decode(split_dir()), 1)}
+
+    def stage_fed() -> dict:
+        return {
+            "resnet50_fed_img_per_sec": round(bench_fed_resnet50(split_dir(), root), 1),
+            "fed_batch": BATCH_FED,
+        }
+
+    run_stage("tpk_decode", stage_tpk)
+    run_stage("grain_decode", stage_grain)
+    run_stage("fed_resnet50", stage_fed)
+    extra["pipeline_host_cpu_cores"] = os.cpu_count()
 
     _partial["done"] = True  # fire() checks this — cancel can lose the race
     _watchdog.cancel()
