@@ -30,19 +30,29 @@ pid, nproc, port, outdir = (
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
 
+# Join through the PRODUCTION entry path (env-var style), not a direct
+# jax.distributed.initialize — regression for r4 weak #1, where
+# initialize_distributed touched the backend before distributed init and
+# every host came up as its own single-process world.
+os.environ["JAX_COORDINATOR_ADDRESS"] = f"localhost:{port}"
+os.environ["JAX_NUM_PROCESSES"] = str(nproc)
+os.environ["JAX_PROCESS_ID"] = str(pid)
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-jax.distributed.initialize(
-    coordinator_address=f"localhost:{port}", num_processes=nproc, process_id=pid
-)
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from turboprune_tpu.parallel import initialize_distributed  # noqa: E402
+
+initialize_distributed()
+assert jax.process_count() == nproc, "initialize_distributed failed to join"
 
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
-
-sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
 from turboprune_tpu.config.compose import compose  # noqa: E402
 from turboprune_tpu.driver import _first_train_batch, run  # noqa: E402

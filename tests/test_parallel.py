@@ -107,6 +107,29 @@ def test_sharded_eval(setup):
     assert float(out["count"]) == 16.0
 
 
+def test_cluster_hint_requires_multi_worker_evidence(monkeypatch):
+    """initialize_distributed must NOT start a distributed service on a
+    single host: the axon tunnel (and other single-worker TPU setups)
+    exports TPU_WORKER_HOSTNAMES=localhost, which used to trip the hint
+    check and crash/hang every entry-script run (caught live in r5)."""
+    from turboprune_tpu.parallel.multihost import _cluster_hinted
+
+    for k in ("OMPI_COMM_WORLD_SIZE", "SLURM_NTASKS", "TPU_WORKER_HOSTNAMES"):
+        monkeypatch.delenv(k, raising=False)
+    assert not _cluster_hinted()
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "localhost")
+    assert not _cluster_hinted()  # single worker — the axon-tunnel case
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host-0,host-1")
+    assert _cluster_hinted()  # real pod
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "localhost")
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "4")
+    assert _cluster_hinted()
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "1")
+    assert not _cluster_hinted()
+    monkeypatch.setenv("SLURM_NTASKS", "8")
+    assert _cluster_hinted()
+
+
 def test_fingerprint_and_equality(setup):
     _, _, state, _ = setup
     fp1 = tree_fingerprint(state.params)

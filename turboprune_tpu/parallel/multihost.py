@@ -25,17 +25,52 @@ import numpy as np
 PyTree = Any
 
 
+def _cluster_hinted() -> bool:
+    """True only when env vars show a MULTI-worker launch whose topology
+    jax.distributed.initialize() can auto-detect (SLURM, OpenMPI, multi-host
+    TPU pod). Presence alone is not enough: single-host environments also
+    set these (the axon tunnel exports TPU_WORKER_HOSTNAMES=localhost), and
+    initializing a 1-process distributed service there is pure downside."""
+    try:
+        if int(os.environ.get("OMPI_COMM_WORLD_SIZE") or 1) > 1:
+            return True
+        if int(os.environ.get("SLURM_NTASKS") or 1) > 1:
+            return True
+    except ValueError:
+        pass
+    # Cloud TPU pods: comma-separated list of all worker hostnames.
+    return "," in os.environ.get("TPU_WORKER_HOSTNAMES", "")
+
+
 def initialize_distributed() -> None:
     """Join the multi-host world when launched under a JAX cluster
     (coordinator env vars / TPU metadata present); no-op single-host.
     The TPU analog of dist.init_process_group("nccl")
-    (distributed_utils.py:63-66) — after this, collectives ride ICI/DCN."""
-    if jax.process_count() > 1:
-        return  # already initialized by the runtime
-    if os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
+    (distributed_utils.py:63-66) — after this, collectives ride ICI/DCN.
+
+    MUST be the first JAX touch in the process: ``jax.process_count()`` /
+    ``jax.devices()`` initialize the backend, after which distributed init
+    is rejected and every host silently comes up as its own single-process
+    world (all-primary — each host writes its own expt dir and
+    ``broadcast_object`` no-ops). So this inspects ONLY env vars before
+    deciding, and calls ``jax.distributed.initialize`` before anything else
+    queries the runtime. Regression-tested via tests/mp_worker.py, which
+    joins its 2-process world through this exact entry path."""
+    if jax.distributed.is_initialized():
+        return  # already joined (e.g. a direct jax.distributed.initialize)
+    coord = os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
         "COORDINATOR_ADDRESS"
-    ):
-        jax.distributed.initialize()
+    )
+    if coord:
+        nproc = os.environ.get("JAX_NUM_PROCESSES")
+        pid = os.environ.get("JAX_PROCESS_ID")
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(nproc) if nproc else None,
+            process_id=int(pid) if pid else None,
+        )
+    elif _cluster_hinted():
+        jax.distributed.initialize()  # cluster auto-detect (SLURM/MPI/pod)
 
 
 def process_index() -> int:
