@@ -157,3 +157,55 @@ def test_deit_tiny_forward():
     variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 224, 224, 3)))
     out = model.apply(variables, jnp.zeros((2, 224, 224, 3)), train=False)
     assert out.shape == (2, 1000)
+
+
+def test_wide_resnet_widths_and_param_count():
+    """wide_resnet50_2 doubles the bottleneck INNER convs only (torchvision
+    width_per_group=128): block outputs keep 4x expansion, total params
+    ~68.9M at 1000 classes."""
+    model = create_model("wide_resnet50_2", num_classes=1000,
+                         dataset_name="ImageNet")
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)))
+    p = variables["params"]
+    # layer1 block0: inner convs 128 wide, output 256 (torchvision shapes)
+    assert p["layer1_0"]["Conv_0"]["kernel"].shape[-1] == 128
+    assert p["layer1_0"]["Conv_2"]["kernel"].shape[-1] == 256
+    n = sum(x.size for x in jax.tree.leaves(p))
+    assert 68_000_000 < n < 69_500_000
+    out = model.apply(variables, jnp.zeros((2, 64, 64, 3)), train=False)
+    assert out.shape == (2, 1000)
+
+
+def test_densenet121_forward_params_and_masks():
+    """torchvision densenet121 ~7.98M params at 1000 classes; masks cover
+    every conv + the classifier (name-based 'kernel' rule)."""
+    model = create_model("densenet121", num_classes=1000,
+                         dataset_name="ImageNet")
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)))
+    p = variables["params"]
+    n = sum(x.size for x in jax.tree.leaves(p))
+    assert 7_800_000 < n < 8_200_000
+    out = model.apply(variables, jnp.zeros((2, 64, 64, 3)), train=False)
+    assert out.shape == (2, 1000)
+    masks = make_masks(p)
+    masked = sum(m.size for m in mask_leaves(masks))
+    kernels = sum(
+        x.size
+        for path, x in jax.tree_util.tree_flatten_with_path(p)[0]
+        if str(getattr(path[-1], "key", path[-1])) == "kernel"
+    )
+    assert masked == kernels > 7_700_000  # convs + classifier dominate
+
+
+def test_densenet121_cifar_stem_prunes_end_to_end():
+    model = create_model("densenet121", num_classes=10, dataset_name="CIFAR10")
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    p = variables["params"]
+    assert p["conv0"]["kernel"].shape[:2] == (3, 3)  # CIFAR stem surgery
+    masks = make_masks(p)
+    masks2 = global_threshold_mask(p, masks, density=0.3)
+    assert abs(overall_density(masks2) - 0.3) < 5e-3
+    pruned = apply_masks(p, masks2)
+    out = model.apply({**variables, "params": pruned},
+                      jnp.zeros((2, 32, 32, 3)), train=False)
+    assert out.shape == (2, 10)

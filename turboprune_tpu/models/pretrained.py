@@ -11,7 +11,11 @@ converter maps it onto the flax ``VisionTransformer`` param pytree
 
 Layout mapping (timm tensor -> flax leaf):
 
-  cls_token / dist_token / pos_embed      -> verbatim (1, ..., D)
+  cls_token / dist_token                  -> verbatim (1, 1, D)
+  pos_embed                               -> verbatim, or prefix-preserving
+                                             bicubic grid interpolation when
+                                             the model's token count differs
+                                             (timm resample_abs_pos_embed)
   patch_embed.proj.weight  (D, 3, P, P)   -> patch_embed.kernel (P, P, 3, D)
   blocks.i.norm{1,2}.weight/bias          -> block{i}.norm{1,2}.scale/bias
   blocks.i.attn.qkv.weight (3D, D)        -> block{i}.attn.{query,key,value}
@@ -89,6 +93,36 @@ def _split_qkv(w: np.ndarray, b: np.ndarray, heads: int):
     return out
 
 
+def _interpolate_pos_embed(
+    pe: np.ndarray, target_tokens: int, n_prefix: int
+) -> np.ndarray:
+    """timm-style position-embedding grid interpolation: keep the cls/dist
+    prefix tokens verbatim, bicubic-resize the square patch grid to the
+    model's grid (timm ``resample_abs_pos_embed``). Lets a 197-token
+    ImageNet/224 checkpoint warm-start e.g. a 32x32-input model (ADVICE r4:
+    without this the advertised CIFAR warm-start workflow could not run)."""
+    src_grid = pe.shape[1] - n_prefix
+    dst_grid = target_tokens - n_prefix
+    if src_grid == dst_grid:
+        return pe
+    s = int(round(src_grid**0.5))
+    d = int(round(dst_grid**0.5))
+    if s * s != src_grid or d * d != dst_grid:
+        raise PretrainedFormatError(
+            f"pos_embed grid not square: checkpoint {src_grid} patches, "
+            f"model {dst_grid} patches (prefix {n_prefix}) — cannot "
+            "interpolate a non-square token grid"
+        )
+    prefix = pe[:, :n_prefix]
+    grid = pe[:, n_prefix:].reshape(1, s, s, pe.shape[-1])
+    resized = np.asarray(
+        jax.image.resize(
+            jnp.asarray(grid), (1, d, d, pe.shape[-1]), method="bicubic"
+        )
+    )
+    return np.concatenate([prefix, resized.reshape(1, d * d, pe.shape[-1])], axis=1)
+
+
 def convert_deit_state_dict(
     sd: dict, params: PyTree, num_heads: int
 ) -> tuple[PyTree, list[str]]:
@@ -128,7 +162,17 @@ def convert_deit_state_dict(
         node[path[-1]] = jnp.asarray(value, dtype=target.dtype)
 
     put(("cls_token",), take("cls_token"))
-    put(("pos_embed",), take("pos_embed"))
+    n_prefix = 2 if "dist_token" in new else 1
+    pe = take("pos_embed")
+    target_tokens = int(new["pos_embed"].shape[1])
+    if pe.shape[1] != target_tokens:
+        pe = _interpolate_pos_embed(pe, target_tokens, n_prefix)
+        print(
+            f"[pretrained] interpolated pos_embed to {pe.shape[1]} tokens "
+            "(checkpoint grid bicubic-resized to model grid)",
+            flush=True,
+        )
+    put(("pos_embed",), pe)
     if "dist_token" in new:
         put(("dist_token",), take("dist_token"))
     put(("patch_embed", "kernel"), take("patch_embed.proj.weight").transpose(2, 3, 1, 0))

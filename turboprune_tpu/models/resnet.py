@@ -63,15 +63,19 @@ class Bottleneck(nn.Module):
     conv: ModuleDef
     norm: ModuleDef
     expansion: int = 4
+    # torchvision wide_resnet*_2: inner 1x1/3x3 width doubles
+    # (width_per_group=128) while the block output stays filters*expansion.
+    inner_multiplier: float = 1.0
 
     @nn.compact
     def __call__(self, x):
         residual = x
-        y = self.conv(self.filters, (1, 1))(x)
+        inner = int(self.filters * self.inner_multiplier)
+        y = self.conv(inner, (1, 1))(x)
         y = self.norm()(y)
         y = nn.relu(y)
         # torchvision puts the stride on the 3x3 conv (ResNet v1.5)
-        y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides))(y)
+        y = self.conv(inner, (3, 3), strides=(self.strides, self.strides))(y)
         y = self.norm()(y)
         y = nn.relu(y)
         y = self.conv(self.filters * self.expansion, (1, 1))(y)
@@ -93,6 +97,9 @@ class ResNet(nn.Module):
     num_classes: int
     cifar_stem: bool = False
     width: int = 64
+    # Bottleneck inner-width multiplier (wide_resnet50_2 = 2.0); only valid
+    # with Bottleneck blocks — BasicBlock rejects it loudly.
+    inner_multiplier: float = 1.0
     dtype: Any = jnp.float32
     bn_momentum: float = 0.9  # = 1 - torch BatchNorm momentum 0.1
     bn_epsilon: float = 1e-5
@@ -126,6 +133,11 @@ class ResNet(nn.Module):
             x = norm(name="bn1")(x)
             x = nn.relu(x)
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        block_kw = (
+            {"inner_multiplier": self.inner_multiplier}
+            if self.inner_multiplier != 1.0
+            else {}
+        )
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = 2 if i > 0 and j == 0 else 1
@@ -135,6 +147,7 @@ class ResNet(nn.Module):
                     conv=conv,
                     norm=norm,
                     name=f"layer{i + 1}_{j}",
+                    **block_kw,
                 )(x)
         x = jnp.mean(x, axis=(1, 2))
         x = x.astype(jnp.float32)
@@ -160,3 +173,19 @@ def resnet101(num_classes: int, cifar_stem: bool = False, **kw) -> ResNet:
 
 def resnet152(num_classes: int, cifar_stem: bool = False, **kw) -> ResNet:
     return ResNet([3, 8, 36, 3], Bottleneck, num_classes, cifar_stem, **kw)
+
+
+def wide_resnet50_2(num_classes: int, cifar_stem: bool = False, **kw) -> ResNet:
+    """torchvision wide_resnet50_2: bottleneck inner width x2
+    (reference reach: custom_models.py:184 accepts any torchvision name)."""
+    return ResNet(
+        [3, 4, 6, 3], Bottleneck, num_classes, cifar_stem,
+        inner_multiplier=2.0, **kw,
+    )
+
+
+def wide_resnet101_2(num_classes: int, cifar_stem: bool = False, **kw) -> ResNet:
+    return ResNet(
+        [3, 4, 23, 3], Bottleneck, num_classes, cifar_stem,
+        inner_multiplier=2.0, **kw,
+    )

@@ -150,6 +150,13 @@ class EncoderBlock(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False):
         dim = x.shape[-1]
+        if self.attention_impl != "dense" and self.attn_dropout_rate > 0:
+            raise ValueError(
+                f"attention_impl={self.attention_impl!r} does not implement "
+                "attention dropout (the blockwise/ring kernels have no "
+                "dropout path); it would otherwise be silently ignored — "
+                "use attention_impl='dense' or attn_dropout_rate=0"
+            )
         y = nn.LayerNorm(epsilon=1e-6, name="norm1")(x)
         if self.attention_impl == "ring":
             y = RingSelfAttention(
