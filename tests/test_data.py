@@ -138,6 +138,45 @@ class TestSyntheticLoaders:
         np.testing.assert_array_equal(a[0], b[0])
         np.testing.assert_array_equal(a[1], b[1])
 
+    @staticmethod
+    def _spectral_oracle(xs, num_classes, image_size):
+        """Bayes-ish classifier for the hard task: |complex projection| of
+        each image onto every (class, variant) grating signature (unknown
+        phase handled by the magnitude), max over variants, argmax class."""
+        from turboprune_tpu.data.synthetic import _grating_signatures
+
+        freqs, colors = _grating_signatures(num_classes, 4, image_size, 12345)
+        x = xs.astype(np.float32) - 128.0
+        xx, yy = np.meshgrid(
+            np.arange(image_size), np.arange(image_size), indexing="ij"
+        )
+        s = np.zeros((len(xs), num_classes, 4))
+        for c in range(num_classes):
+            for v in range(4):
+                fx, fy = freqs[c, v]
+                basis = np.exp(-2j * np.pi * (fx * xx + fy * yy) / image_size)
+                proj = np.einsum("nhwc,c->nhw", x, colors[c, v])
+                s[:, c, v] = np.abs(np.einsum("nhw,hw->n", proj, basis))
+        return s.max(2).argmax(1)
+
+    def test_hard_synthetic_oracle_band(self):
+        """The hard task must be learnable-but-not-trivial: the spectral
+        oracle should land well below 100% but far above chance at the
+        default snr — the band that makes accuracy curves discriminate
+        between training types (VERDICT r4 missing #2)."""
+        xs, ys = synthetic_arrays(512, 32, 10, seed=7, task="hard", snr=1.5)
+        acc = (self._spectral_oracle(xs, 10, 32) == ys).mean()
+        assert 0.85 < acc < 0.995, acc  # snr=1.5 calibration band
+
+    def test_hard_synthetic_shares_structure_across_splits(self):
+        """Different sample seeds (train/test) must share signatures: the
+        SAME signature bank classifies both splits — at snr=5 near-perfectly
+        — so class structure is split-invariant."""
+        a_x, a_y = synthetic_arrays(64, 16, 3, seed=1, task="hard", snr=5.0)
+        b_x, b_y = synthetic_arrays(64, 16, 3, seed=2, task="hard", snr=5.0)
+        assert (self._spectral_oracle(a_x, 3, 16) == a_y).mean() > 0.95
+        assert (self._spectral_oracle(b_x, 3, 16) == b_y).mean() > 0.95
+
 
 class TestGrainImageNet:
     @pytest.fixture(scope="class")

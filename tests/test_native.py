@@ -170,3 +170,44 @@ class TestLoader:
         got = np.concatenate([np.asarray(b[1]) for b in batches])
         np.testing.assert_array_equal(got[got >= 0], labels)
         assert (got < 0).sum() == 8 * len(batches) - 20
+
+    def test_shard_remainder_covers_every_sample(self):
+        """n % nproc != 0: strided shards must partition range(n) exactly
+        (the pre-r5 contiguous split dropped the last n % nproc samples
+        from every epoch — r4 weak #4), mirroring the grain disjointness
+        test in mp_worker.py."""
+        from turboprune_tpu.data.native import make_shard
+
+        for n, nproc in [(11, 2), (11, 3), (20, 4), (7, 8)]:
+            shards = [make_shard(n, p, nproc) for p in range(nproc)]
+            everything = sorted(int(i) for s in shards for i in s)
+            assert everything == list(range(n)), (n, nproc)
+            # sizes differ by at most one -> a globally-agreed
+            # floor(n/nproc)//bs train step count never overruns a shard
+            sizes = {len(s) for s in shards}
+            assert max(sizes) - min(sizes) <= 1
+
+    def test_train_drop_last_tail_rotates_across_epochs(self, raw_tpk):
+        """n=20, bs=8 -> 2 steps/epoch, 4 samples fall off the drop-last
+        tail each epoch. The per-epoch shuffle must rotate WHICH samples,
+        so every sample appears within a few epochs — the contract the
+        class docstring promises (no permanent exclusion)."""
+        path, images, _ = raw_tpk
+        loader = TpkImageLoader(path, total_batch_size=8, train=True, image_size=8)
+        assert len(loader) == 2
+        from turboprune_tpu.data.imagenet import IMAGENET_MEAN, IMAGENET_STD
+
+        mean = np.asarray(IMAGENET_MEAN, np.float32)
+        std = np.asarray(IMAGENET_STD, np.float32)
+        seen: set[bytes] = set()
+        for _ in range(8):
+            for batch_images, labels in loader:
+                assert batch_images.shape[0] == 8
+                # Invert normalize_uint8 back to exact uint8 identity
+                # (float rounding differs across batch shapes, so comparing
+                # normalized floats bitwise would be flaky).
+                back = np.asarray(batch_images) * std + mean
+                for row in np.rint(back * 255.0).astype(np.uint8):
+                    seen.add(row.tobytes())
+        want = {img.tobytes() for img in images}
+        assert seen == want  # every one of the 20 samples was visited

@@ -78,6 +78,12 @@ class DatasetConfig:
     # Synthetic-loader sizes (dataloader_type=synthetic only).
     synthetic_num_train: int = 2048
     synthetic_num_test: int = 512
+    # "easy": separable class-mean colors (saturates at 100% — loop tests);
+    # "hard": template-mixture task whose accuracy sits below the ceiling
+    # and bends with density (science-bearing runs). snr scales difficulty.
+    synthetic_task: str = "easy"
+    # 1.5 -> spectral-oracle ~96% at 32px/10 classes (tests/test_data.py).
+    synthetic_snr: float = 1.5
     # Native packed-dataset loader (dataloader_type=tpk): .tpk file paths;
     # empty = <data_root_dir>/{train,val}.tpk. With tpk_auto_pack, missing
     # .tpk files are packed once from ImageFolder splits under data_root_dir
@@ -103,6 +109,12 @@ class DatasetConfig:
                 )
             if self.synthetic_num_test < 1:
                 raise ConfigError("synthetic_num_test must be >= 1")
+            _check_choice(
+                "dataset_params.synthetic_task", self.synthetic_task,
+                ("easy", "hard"),
+            )
+            if self.synthetic_snr <= 0:
+                raise ConfigError("synthetic_snr must be positive")
         if self.image_size == 0:
             self.image_size = 224 if self.dataset_name == "ImageNet" else 32
         if self.num_classes == 0:
@@ -206,6 +218,11 @@ class ExperimentConfig:
     use_wandb: bool = False
     # When set, write a jax.profiler trace of level-0 epoch-1 here.
     profile_dir: str = ""
+    # Epoch-granular checkpointing (0 = off): every N epochs the full train
+    # state is saved to one rotating mid_level slot, and a resumed run
+    # re-enters the interrupted level at the saved epoch instead of
+    # replaying it (beyond-reference; for preemptible TPUs).
+    checkpoint_every_epochs: int = 0
 
     def validate(self) -> None:
         _check_choice(
@@ -215,6 +232,8 @@ class ExperimentConfig:
             raise ConfigError("epochs_per_level must be positive")
         if self.model_parallelism < 1:
             raise ConfigError("model_parallelism must be >= 1")
+        if self.checkpoint_every_epochs < 0:
+            raise ConfigError("checkpoint_every_epochs must be >= 0")
 
 
 @dataclass

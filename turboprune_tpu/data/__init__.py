@@ -55,6 +55,8 @@ def create_loaders(cfg) -> Any:
             num_train=dp.synthetic_num_train,
             num_test=dp.synthetic_num_test,
             seed=seed,
+            task=dp.synthetic_task,
+            snr=dp.synthetic_snr,
         )
     if dp.dataloader_type == "device":
         if dp.dataset_name not in ("CIFAR10", "CIFAR100"):

@@ -168,6 +168,14 @@ class DeviceCifarLoader:
         if self.aug.get("translate", 0) > 0:
             base = pad_reflect(base, int(self.aug["translate"]))
         self._base = jax.device_put(base)
+        # Per-epoch keys are derived STATELESSLY from this base key +
+        # the epoch counter (fold_in), never from a chained split: the
+        # counter is then the loader's entire RNG state, so mid-level
+        # resume (harness) restores the exact augmentation/shuffle stream
+        # by restoring one int. The tpk loader uses the same seed+epoch
+        # discipline; grain does NOT (persistent stream position — it
+        # declares resumable_epochs = False instead).
+        self._epoch_key = self._key
 
     def __len__(self) -> int:
         n = self.labels.shape[0]
@@ -182,7 +190,9 @@ class DeviceCifarLoader:
         state)."""
         epoch = self.epoch
         self.epoch += 1
-        self._key, k_aug, k_perm = jax.random.split(self._key, 3)
+        k_aug, k_perm = jax.random.split(
+            jax.random.fold_in(self._epoch_key, epoch)
+        )
 
         if self.aug:
             images = augment_epoch(

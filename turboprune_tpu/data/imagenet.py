@@ -164,9 +164,19 @@ class GrainImageLoader:
     by world size, dataset.py:411); sharding is ``ShardByJaxProcess`` so each
     host reads a disjoint slice — FFCV's ``distributed=True`` equivalent.
     ``batch_scope = "host"``: each yielded batch is THIS host's slice; the
-    harness assembles the global array (parallel.assemble_batch)."""
+    harness assembles the global array (parallel.assemble_batch).
+
+    ``resumable_epochs = False``: the train side draws fixed windows off ONE
+    persistent shuffle stream (see _raw_batches), so the stream POSITION —
+    not the epoch counter — is the real data-order state, and it dies with
+    the process. Mid-level resume (harness) therefore cannot replay the
+    exact order: a resumed run is statistically equivalent (fresh shuffle
+    pass) but not bit-identical, and the harness says so loudly. The
+    device/tpk/synthetic loaders derive each epoch purely from
+    (seed, epoch) and ARE bit-exactly resumable."""
 
     batch_scope = "host"
+    resumable_epochs = False
 
     def __init__(
         self,

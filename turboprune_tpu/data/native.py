@@ -204,11 +204,35 @@ class TpkFile:
         return images, labels
 
 
+def make_shard(n: int, pid: int, nproc: int) -> np.ndarray:
+    """Strided per-host shard (host p takes samples p, p+nproc, ...).
+
+    The sharding contract (FFCV ``distributed=True`` analog,
+    /root/reference/utils/dataset.py:411-418): every sample belongs to
+    exactly one host's shard — strided assignment covers the ``n % nproc``
+    remainder that a contiguous ``n // nproc`` split would permanently drop
+    (r4 weak #4). Shard sizes differ by at most one; lockstep is restored by
+    the loader's globally-agreed step count (train) or eval padding."""
+    return np.arange(pid, n, nproc, dtype=np.int64)
+
+
 class TpkImageLoader:
     """Epoch iterator over a .tpk: native decode, per-host sharding, device
     normalize — the FFCV ``Loader`` contract (dataset.py:409-430): train =
     shuffled + drop_last, eval = sequential + keep last.
-    ``batch_scope = "host"``: yields THIS host's slice of the global batch."""
+    ``batch_scope = "host"``: yields THIS host's slice of the global batch.
+
+    Sharding contract (both splits strided, see ``make_shard``):
+      train: all hosts run ``(n // nproc) // batch_size`` steps — identical
+        on every host by construction, so SPMD steps stay in lockstep even
+        when shard sizes differ by one. Up to ``batch_size - 1 + (1 if the
+        shard has the extra sample)`` samples per host per epoch fall off
+        the drop-last tail, but the per-epoch shuffle rotates WHICH samples,
+        so none is permanently excluded (unlike the pre-r5 contiguous split,
+        which silently never visited the last ``n % nproc`` samples at all).
+      eval: every sample visited exactly once; short final/odd-shard batches
+        are padded with sentinel labels (data/padding.py) and all hosts run
+        the same global ceil step count."""
 
     batch_scope = "host"
 
@@ -231,22 +255,17 @@ class TpkImageLoader:
         self.seed = seed
         self.nthreads = nthreads or min(16, os.cpu_count() or 1)
         self.epoch = 0
-        # Per-host contiguous shard (FFCV distributed=True analog).
-        n = self.file.num_samples
-        pid = jax.process_index()
-        if train:
-            per = n // nproc
-            self._shard = np.arange(pid * per, (pid + 1) * per, dtype=np.int64)
-        else:
-            self._shard = np.arange(pid, n, nproc, dtype=np.int64)
+        self._nproc = nproc
+        self._shard = make_shard(self.file.num_samples, jax.process_index(), nproc)
 
     def __len__(self) -> int:
         if self.train:
-            return len(self._shard) // self.batch_size
+            # GLOBAL train step count — floor(n/nproc)//bs is identical on
+            # every host (shard sizes differ by one; see class docstring).
+            return (self.file.num_samples // self._nproc) // self.batch_size
         # GLOBAL eval batch count (largest shard, ceil) — identical on every
         # host so lockstep SPMD eval steps line up; short shards pad.
-        nproc = jax.process_count()
-        max_shard = -(-self.file.num_samples // nproc)
+        max_shard = -(-self.file.num_samples // self._nproc)
         return -(-max_shard // self.batch_size)
 
     def _decode_batch(self, order: np.ndarray, b: int, epoch: int):

@@ -24,20 +24,86 @@ def synthetic_arrays(
     num_classes: int,
     seed: int = 0,
     class_seed: int = 12345,
+    task: str = "easy",
+    snr: float = 1.0,
 ) -> tuple[np.ndarray, np.ndarray]:
-    """Class-conditional uint8 images: each class gets a distinct mean so a
-    model can actually fit the data (integration tests check learning, not
-    just shapes). The class means are drawn from ``class_seed`` ONLY —
-    train/test splits (different ``seed``) share the same class structure,
-    otherwise eval would be structurally random."""
-    means = np.random.default_rng(class_seed).uniform(
-        40.0, 215.0, size=(num_classes, 1, 1, 3)
-    )
+    """Class-conditional uint8 images; class structure is drawn from
+    ``class_seed`` ONLY — train/test splits (different ``seed``) share the
+    same class structure, otherwise eval would be structurally random.
+
+    task="easy": each class gets a distinct mean color (noise sigma 25) —
+    trivially separable, every run saturates at 100%. Kept for tests and
+    benches that check "the loop learns", not the science.
+
+    task="hard": all classes share the same mean gray; class c is a MIXTURE
+    of four low-amplitude sinusoidal gratings (distinct spatial frequency +
+    color axis per variant, phase randomized PER SAMPLE) buried in noise.
+    Texture detection is translation-invariant — exactly what a CNN with
+    global pooling is good at (a full-image matched-filter task would be
+    structurally unlearnable through an avg-pool head) — but discriminating
+    ~4*num_classes similar spectral signatures takes real filter capacity
+    and a max over variants (nonlinear), so accuracy sits below the ceiling
+    and bends as density falls. That is what lets the imp/wr/lrr accuracy
+    curves carry signal (VERDICT r4 missing #2 — at the 100% ceiling a
+    wrong rewind would be invisible). ``snr`` scales grating amplitude;
+    calibrate with the spectral-oracle accuracy printed by
+    tests/test_data.py::test_hard_synthetic_oracle_band."""
     rng = np.random.default_rng(seed)
     labels = rng.integers(0, num_classes, size=(num_samples,), dtype=np.int64)
-    noise = rng.normal(0.0, 25.0, size=(num_samples, image_size, image_size, 3))
-    images = np.clip(means[labels] + noise, 0, 255).astype(np.uint8)
+    noise_sigma = 25.0
+    noise = rng.normal(
+        0.0, noise_sigma, size=(num_samples, image_size, image_size, 3)
+    )
+    if task == "easy":
+        means = np.random.default_rng(class_seed).uniform(
+            40.0, 215.0, size=(num_classes, 1, 1, 3)
+        )
+        images = np.clip(means[labels] + noise, 0, 255).astype(np.uint8)
+        return images, labels.astype(np.int32)
+    if task != "hard":
+        raise ValueError(f"synthetic task {task!r} not in ('easy', 'hard')")
+    variants = 4
+    freqs, colors = _grating_signatures(num_classes, variants, image_size,
+                                        class_seed)
+    # Per-bin spectral z-score ~ amp*sqrt(npix/2)/sigma; 3*snr gives a
+    # tunable margin against the other signatures' bins.
+    amp = 3.0 * snr * noise_sigma / np.sqrt(image_size * image_size / 2.0)
+    which = rng.integers(0, variants, size=(num_samples,))
+    phase = rng.uniform(0.0, 2 * np.pi, size=(num_samples,))
+    xx, yy = np.meshgrid(np.arange(image_size), np.arange(image_size),
+                         indexing="ij")
+    fx = freqs[labels, which, 0, None, None]
+    fy = freqs[labels, which, 1, None, None]
+    wave = np.sin(
+        2 * np.pi * (fx * xx[None] + fy * yy[None]) / image_size
+        + phase[:, None, None]
+    )
+    signal = amp * wave[..., None] * colors[labels, which][:, None, None, :]
+    images = np.clip(128.0 + signal + noise, 0, 255).astype(np.uint8)
     return images, labels.astype(np.int32)
+
+
+def _grating_signatures(
+    num_classes: int, variants: int, image_size: int, class_seed: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Distinct (fx, fy) integer spatial frequencies + unit color axes for
+    every (class, variant) signature, drawn from ``class_seed`` only."""
+    rng = np.random.default_rng(class_seed)
+    fmax = max(2, image_size // 4)
+    pairs = np.array(
+        [(fx, fy) for fx in range(fmax) for fy in range(fmax) if fx or fy]
+    )
+    need = num_classes * variants
+    if need > len(pairs):
+        raise ValueError(
+            f"hard synthetic task: {need} signatures exceed the "
+            f"{len(pairs)} distinct frequency pairs at image_size={image_size}"
+        )
+    chosen = pairs[rng.choice(len(pairs), size=need, replace=False)]
+    freqs = chosen.reshape(num_classes, variants, 2)
+    colors = rng.normal(0.0, 1.0, size=(num_classes, variants, 3))
+    colors /= np.linalg.norm(colors, axis=-1, keepdims=True)
+    return freqs, colors
 
 
 class SyntheticLoaders:
@@ -53,13 +119,15 @@ class SyntheticLoaders:
         num_train: int = 2048,
         num_test: int = 512,
         seed: int = 0,
+        task: str = "easy",
+        snr: float = 1.0,
     ):
         self.num_classes = num_classes
         train_x, train_y = synthetic_arrays(
-            num_train, image_size, num_classes, seed=seed
+            num_train, image_size, num_classes, seed=seed, task=task, snr=snr
         )
         test_x, test_y = synthetic_arrays(
-            num_test, image_size, num_classes, seed=seed + 1
+            num_test, image_size, num_classes, seed=seed + 1, task=task, snr=snr
         )
         cifar_name = "CIFAR100" if dataset_name == "CIFAR100" else "CIFAR10"
         self.train_loader = DeviceCifarLoader(
