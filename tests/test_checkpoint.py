@@ -36,6 +36,42 @@ def _first_param(tree):
     return jax.tree.leaves(tree)[0]
 
 
+class TestMidLevelSlot:
+    def test_roundtrip_and_torn_save_guard(self, small_state, tmp_path):
+        """The slot embeds a (level, epoch) tag inside the atomically-written
+        Orbax tree; load_mid_level returns None when the caller's
+        header-derived expectation disagrees (a preemption between the state
+        write and the header write), so a mixed restore can never happen."""
+        _, _, state = small_state
+        ckpts = ExperimentCheckpoints(tmp_path)
+        ckpts.save_mid_level(
+            2, 3, state, meta={"max_test_acc": 42.0, "train_loader_epoch": 13}
+        )
+        meta = ckpts.peek_mid_level()
+        assert (meta["level"], meta["epoch"]) == (2, 3)
+        assert meta["train_loader_epoch"] == 13
+
+        got = ckpts.load_mid_level(state, expect_level=2, expect_epoch=3)
+        assert got is not None
+        np.testing.assert_array_equal(
+            _first_param(got["params"]), _first_param(state.params)
+        )
+        # Stale header (older save) -> refuse, don't mix.
+        assert ckpts.load_mid_level(state, expect_level=2, expect_epoch=1) is None
+        assert ckpts.load_mid_level(state, expect_level=1, expect_epoch=3) is None
+
+        ckpts.clear_mid_level()
+        assert ckpts.peek_mid_level() is None
+        assert not ckpts.mid_level_path().exists()
+
+    def test_peek_tolerates_corrupt_header(self, small_state, tmp_path):
+        _, _, state = small_state
+        ckpts = ExperimentCheckpoints(tmp_path)
+        ckpts.save_mid_level(0, 1, state, meta={})
+        ckpts._mid_level_meta_path().write_text("{truncated")
+        assert ckpts.peek_mid_level() is None  # no JSONDecodeError escape
+
+
 class TestPytreeRoundTrip:
     def test_masks_none_leaves_and_bool_dtype_survive(self, small_state, tmp_path):
         _, _, state = small_state

@@ -39,6 +39,7 @@ from ..ops import masking
 from ..parallel import (
     assemble_batch,
     create_mesh,
+    is_primary,
     epoch_sharding,
     make_sharded_eval_step,
     make_sharded_scan_epoch,
@@ -58,6 +59,7 @@ from ..train import (
     make_train_step,
 )
 from ..utils import (
+    MID_LEVEL,
     MODEL_INIT,
     MODEL_REWIND,
     OPTIMIZER_INIT,
@@ -330,8 +332,69 @@ class PruningHarness:
 
         rewind_epoch = self.cfg.pruning_params.rewind_epoch
         profile_dir = self.cfg.experiment_params.profile_dir
+        ckpt_every = self.cfg.experiment_params.checkpoint_every_epochs
         max_test_acc = 0.0
-        for epoch in range(epochs_per_level):
+        start_epoch = 0
+        mid = self.ckpts.peek_mid_level() if ckpt_every else None
+        if mid and mid["level"] != level:
+            # Levels run in ascending order, so a slot for a different level
+            # is always from an abandoned trajectory (e.g. resumed BELOW a
+            # preempted level) — drop it before it can hijack a later
+            # re-run of its level.
+            self.ckpts.clear_mid_level()
+        elif mid:
+            # Epoch-granular re-entry (beyond-reference; checkpoint.py
+            # MID_LEVEL): restore the FULL state — opt_state and step come
+            # back mid-schedule — and fast-forward the train loader's epoch
+            # counter so the per-epoch shuffle/augment PRNG stream continues
+            # exactly where the interrupted run left it (bit-identical to an
+            # uninterrupted run; asserted in tests/test_harness.py).
+            restored = self.ckpts.load_mid_level(
+                self.state, expect_level=level, expect_epoch=mid["epoch"]
+            )
+            if restored is None:
+                # Torn save (header and state tree from different saves):
+                # replay the level from its start instead of mixing them.
+                if is_primary():
+                    print(
+                        "[resume] mid-level slot is torn (header/state "
+                        "disagree) — replaying the level",
+                        flush=True,
+                    )
+                self.ckpts.clear_mid_level()
+            else:
+                self.state = replicate(
+                    self.state.replace(**restored), self.mesh
+                )
+                start_epoch = mid["epoch"] + 1
+                max_test_acc = mid.get("max_test_acc", 0.0)
+                # Pre-preemption epoch rows ride in the header so the level
+                # CSV and the summary's max_test_acc cover the WHOLE level,
+                # not just the post-resume epochs.
+                self.metrics.level_rows = [
+                    dict(r) for r in mid.get("level_rows", [])
+                ]
+                train_loader = self.loaders.train_loader
+                if getattr(train_loader, "resumable_epochs", True) and hasattr(
+                    train_loader, "epoch"
+                ):
+                    train_loader.epoch = mid["train_loader_epoch"]
+                elif is_primary():
+                    print(
+                        "[resume] WARNING: this loader's data-order state "
+                        "is a stream position that did not survive the "
+                        "process (grain); the resumed run sees a fresh "
+                        "shuffle pass — statistically equivalent, NOT "
+                        "bit-identical to an uninterrupted run",
+                        flush=True,
+                    )
+                if is_primary():
+                    print(
+                        f"[resume] mid-level checkpoint: re-entering level "
+                        f"{level} at epoch {start_epoch}",
+                        flush=True,
+                    )
+        for epoch in range(start_epoch, epochs_per_level):
             # Trace the second epoch of level 0 (first is compile-polluted).
             tracing = bool(profile_dir) and level == 0 and epoch == 1
             if tracing:
@@ -353,6 +416,26 @@ class PruningHarness:
                 # 212-223).
                 self.ckpts.save_model(MODEL_REWIND, self.state)
                 self.ckpts.save_optimizer(OPTIMIZER_REWIND, self.state.opt_state)
+
+            if (
+                ckpt_every
+                and (epoch + 1) % ckpt_every == 0
+                and epoch + 1 < epochs_per_level  # last epoch -> level ckpt
+            ):
+                self.ckpts.save_mid_level(
+                    level,
+                    epoch,
+                    self.state,
+                    meta={
+                        "max_test_acc": max_test_acc,
+                        "train_loader_epoch": getattr(
+                            self.loaders.train_loader, "epoch", 0
+                        ),
+                        # So the level CSV / summary survive the preemption
+                        # (rows are plain float/int dicts — JSON-safe).
+                        "level_rows": self.metrics.level_rows,
+                    },
+                )
 
         return self.metrics.finish_level(
             level,

@@ -2,6 +2,7 @@
 /root/reference/utils/harness_utils.py + torch.save plumbing)."""
 
 from .checkpoint import (
+    MID_LEVEL,
     MODEL_INIT,
     MODEL_REWIND,
     OPTIMIZER_INIT,
@@ -26,6 +27,7 @@ __all__ = [
     "reset_weights",
     "save_pytree",
     "restore_pytree",
+    "MID_LEVEL",
     "MODEL_INIT",
     "MODEL_REWIND",
     "OPTIMIZER_INIT",

@@ -36,6 +36,7 @@ MODEL_INIT = "model_init"
 MODEL_REWIND = "model_rewind"
 OPTIMIZER_INIT = "optimizer_init"
 OPTIMIZER_REWIND = "optimizer_rewind"
+MID_LEVEL = "mid_level"
 
 _LEVEL_RE = re.compile(r"^model_level_(\d+)$")
 
@@ -160,6 +161,99 @@ class ExperimentCheckpoints:
             if m:
                 out.append(int(m.group(1)))
         return sorted(out)
+
+    # --- mid-level (epoch-granular) role ----------------------------------
+    # Beyond-reference: the reference can only resume at level granularity
+    # (a preemption at epoch 85/90 replays the whole level). On preemptible
+    # TPUs epoch-granular re-entry is the robustness feature that actually
+    # matters (SURVEY.md §5), so one rotating slot holds the FULL train
+    # state (params/masks/batch_stats/opt_state/step) plus a tiny JSON
+    # header that can be peeked without deserializing the state.
+
+    def mid_level_path(self) -> Path:
+        return self.checkpoints_dir / MID_LEVEL
+
+    def _mid_level_meta_path(self) -> Path:
+        return self.checkpoints_dir / "mid_level_meta.json"
+
+    def save_mid_level(self, level: int, epoch: int, state, meta: dict) -> None:
+        import json
+
+        from ..parallel.multihost import is_primary, sync_hosts
+
+        # The (level, epoch) tag is stored in BOTH the (atomically-written)
+        # Orbax tree and the JSON header. A preemption between the two
+        # writes leaves them disagreeing; load_mid_level detects that and
+        # the harness falls back to replaying the level — never a mixed
+        # old-header/new-state restore.
+        tag = level * 1_000_000 + epoch  # int: Orbax round-trips it exactly
+        save_pytree(
+            self.mid_level_path(),
+            {
+                "params": state.params,
+                "masks": state.masks,
+                "batch_stats": state.batch_stats,
+                "opt_state": state.opt_state,
+                "step": state.step,
+                "tag": tag,
+            },
+        )
+        if is_primary():
+            p = self._mid_level_meta_path()
+            tmp = p.with_suffix(".tmp")  # atomic: no truncated JSON on crash
+            tmp.write_text(json.dumps({"level": level, "epoch": epoch, **meta}))
+            tmp.replace(p)
+        sync_hosts("mid_level_meta")
+
+    def peek_mid_level(self) -> Optional[dict]:
+        """Header {level, epoch, ...} or None — no state deserialization.
+        The header may be one save older than the state tree (see
+        save_mid_level); load_mid_level is the consistency authority."""
+        import json
+
+        p = self._mid_level_meta_path()
+        if not p.exists() or not self.mid_level_path().exists():
+            return None
+        try:
+            return json.loads(p.read_text())
+        except (ValueError, OSError):
+            return None
+
+    def load_mid_level(self, like_state, expect_level: int, expect_epoch: int):
+        """Restore the slot; returns the state dict, or None when the slot's
+        embedded tag disagrees with the header-derived expectation (a torn
+        save — the caller must replay the level from its start)."""
+        restored = restore_pytree(
+            self.mid_level_path(),
+            {
+                "params": like_state.params,
+                "masks": like_state.masks,
+                "batch_stats": like_state.batch_stats,
+                "opt_state": like_state.opt_state,
+                "step": like_state.step,
+                "tag": 0,
+            },
+        )
+        if int(restored.pop("tag")) != expect_level * 1_000_000 + expect_epoch:
+            return None
+        return restored
+
+    def clear_mid_level(self) -> None:
+        """Drop the slot (primary-only). Called whenever training reaches a
+        level the slot does not belong to: levels run in ascending order, so
+        a non-matching slot is always from an abandoned trajectory and would
+        otherwise hijack a later re-run of its level (e.g. resume at level 2
+        after a preemption at level 3 — the recomputed level-3 entry must
+        not restore the old trajectory's state)."""
+        import shutil
+
+        from ..parallel.multihost import is_primary, sync_hosts
+
+        if is_primary():
+            self._mid_level_meta_path().unlink(missing_ok=True)
+            if self.mid_level_path().exists():
+                shutil.rmtree(self.mid_level_path())
+        sync_hosts("mid_level_clear")
 
     # --- optimizer roles --------------------------------------------------
     def save_optimizer(self, role: str, opt_state) -> None:
