@@ -64,6 +64,22 @@ class TestMidLevelSlot:
         assert ckpts.peek_mid_level() is None
         assert not ckpts.mid_level_path().exists()
 
+    def test_stream_blob_tag_roundtrip_and_mismatch(self, tmp_path):
+        """Per-host stream blobs are tagged with (level, epoch): a blob from
+        a different save (torn write between state and stream) or a missing
+        file returns None, and clear_mid_level removes every host's file."""
+        ckpts = ExperimentCheckpoints(tmp_path)
+        ckpts.save_mid_level_stream(3, 1, b"grain-state-host0", pid=0)
+        ckpts.save_mid_level_stream(3, 1, b"grain-state-host1", pid=1)
+        assert ckpts.load_mid_level_stream(3, 1, pid=0) == b"grain-state-host0"
+        assert ckpts.load_mid_level_stream(3, 1, pid=1) == b"grain-state-host1"
+        assert ckpts.load_mid_level_stream(3, 3, pid=0) is None  # other save
+        assert ckpts.load_mid_level_stream(2, 1, pid=0) is None
+        assert ckpts.load_mid_level_stream(3, 1, pid=7) is None  # no file
+        ckpts.clear_mid_level()
+        assert ckpts.load_mid_level_stream(3, 1, pid=0) is None
+        assert ckpts.load_mid_level_stream(3, 1, pid=1) is None
+
     def test_peek_tolerates_corrupt_header(self, small_state, tmp_path):
         _, _, state = small_state
         ckpts = ExperimentCheckpoints(tmp_path)

@@ -222,3 +222,31 @@ class TestGrainImageNet:
         b = [np.asarray(x[0]) for x in loader]
         for x, y in zip(a, b):
             np.testing.assert_array_equal(x, y)
+
+    def test_train_stream_state_resumes_exact_order(self, fake_imagefolder):
+        """The stream-state protocol (mid-level resume): a fresh loader
+        restored from get_stream_state() must replay the original stream's
+        NEXT epoch exactly — position, shuffle pass, and augmentation
+        stream all ride in grain's checkpointable iterator state."""
+        from turboprune_tpu.data.imagenet import GrainImageLoader
+
+        def make():
+            return GrainImageLoader(
+                str(fake_imagefolder / "train"), 2, train=True,
+                num_workers=0, seed=0,
+            )
+
+        first = make()
+        assert first.get_stream_state() is None  # no stream yet
+        _ = list(first)  # epoch 1 consumed
+        state = first.get_stream_state()
+        assert isinstance(state, bytes)
+        want = [(np.asarray(i), np.asarray(l)) for i, l in first]  # epoch 2
+
+        resumed = make()
+        resumed.set_stream_state(state)
+        got = [(np.asarray(i), np.asarray(l)) for i, l in resumed]
+        assert len(got) == len(want)
+        for (gi, gl), (wi, wl) in zip(got, want):
+            np.testing.assert_array_equal(gi, wi)
+            np.testing.assert_array_equal(gl, wl)

@@ -66,6 +66,7 @@ class ImageFolderSource:
     (jpeg_bytes, label) so decode happens in worker processes."""
 
     def __init__(self, split_dir: str):
+        self._split_dir = str(split_dir)
         self.paths, self.labels, self.classes = _index_image_folder(Path(split_dir))
 
     def __len__(self) -> int:
@@ -74,6 +75,17 @@ class ImageFolderSource:
     def __getitem__(self, i) -> tuple[bytes, int]:
         with open(self.paths[i], "rb") as f:
             return f.read(), self.labels[i]
+
+    def __repr__(self) -> str:
+        # STABLE repr (no object id): grain's iterator checkpoints embed
+        # repr(data_source) and set_state refuses to restore when it
+        # differs — the default repr would make every restore fail across
+        # processes (mid-level resume, data/imagenet.py stream-state
+        # protocol).
+        return (
+            f"ImageFolderSource({self._split_dir!r}, n={len(self.paths)}, "
+            f"classes={len(self.classes)})"
+        )
 
 
 def _decode_rgb(data: bytes):
@@ -168,12 +180,13 @@ class GrainImageLoader:
 
     ``resumable_epochs = False``: the train side draws fixed windows off ONE
     persistent shuffle stream (see _raw_batches), so the stream POSITION —
-    not the epoch counter — is the real data-order state, and it dies with
-    the process. Mid-level resume (harness) therefore cannot replay the
-    exact order: a resumed run is statistically equivalent (fresh shuffle
-    pass) but not bit-identical, and the harness says so loudly. The
-    device/tpk/synthetic loaders derive each epoch purely from
-    (seed, epoch) and ARE bit-exactly resumable."""
+    not the epoch counter — is the real data-order state; restoring the
+    counter alone cannot replay the order. Instead this loader exposes the
+    stream-state protocol (``get_stream_state``/``set_stream_state``,
+    grain's checkpointable iterator) and the harness's mid-level resume
+    carries those bytes in its header, making grain resume exact too. The
+    device/tpk/synthetic loaders derive each epoch purely from (seed,
+    epoch) and restore via the counter."""
 
     batch_scope = "host"
     resumable_epochs = False
@@ -229,6 +242,19 @@ class GrainImageLoader:
     @property
     def num_classes(self) -> int:
         return len(self.source.classes)
+
+    # Stream-state protocol (mid-level resume): grain's DataLoaderIterator
+    # is checkpointable, so the persistent stream's exact position survives
+    # a preemption as an opaque byte blob in the mid-save header.
+    def get_stream_state(self) -> Optional[bytes]:
+        if self._stream is None:
+            return None
+        return self._stream.get_state()
+
+    def set_stream_state(self, state: bytes) -> None:
+        if self._stream is None:
+            self._stream = iter(self._make_loader(num_epochs=None))
+        self._stream.set_state(state)
 
     def _make_loader(self, num_epochs: Optional[int]):
         sampler = grain.IndexSampler(

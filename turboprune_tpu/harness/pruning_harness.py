@@ -374,20 +374,7 @@ class PruningHarness:
                 self.metrics.level_rows = [
                     dict(r) for r in mid.get("level_rows", [])
                 ]
-                train_loader = self.loaders.train_loader
-                if getattr(train_loader, "resumable_epochs", True) and hasattr(
-                    train_loader, "epoch"
-                ):
-                    train_loader.epoch = mid["train_loader_epoch"]
-                elif is_primary():
-                    print(
-                        "[resume] WARNING: this loader's data-order state "
-                        "is a stream position that did not survive the "
-                        "process (grain); the resumed run sees a fresh "
-                        "shuffle pass — statistically equivalent, NOT "
-                        "bit-identical to an uninterrupted run",
-                        flush=True,
-                    )
+                self._restore_train_stream(mid, level)
                 if is_primary():
                     print(
                         f"[resume] mid-level checkpoint: re-entering level "
@@ -422,20 +409,29 @@ class PruningHarness:
                 and (epoch + 1) % ckpt_every == 0
                 and epoch + 1 < epochs_per_level  # last epoch -> level ckpt
             ):
-                self.ckpts.save_mid_level(
-                    level,
-                    epoch,
-                    self.state,
-                    meta={
-                        "max_test_acc": max_test_acc,
-                        "train_loader_epoch": getattr(
-                            self.loaders.train_loader, "epoch", 0
-                        ),
-                        # So the level CSV / summary survive the preemption
-                        # (rows are plain float/int dicts — JSON-safe).
-                        "level_rows": self.metrics.level_rows,
-                    },
+                meta = {
+                    "max_test_acc": max_test_acc,
+                    "train_loader_epoch": getattr(
+                        self.loaders.train_loader, "epoch", 0
+                    ),
+                    # So the level CSV / summary survive the preemption
+                    # (rows are plain float/int dicts — JSON-safe).
+                    "level_rows": self.metrics.level_rows,
+                }
+                get_stream = getattr(
+                    self.loaders.train_loader, "get_stream_state", None
                 )
+                if get_stream is not None:
+                    stream = get_stream()
+                    if stream is not None:
+                        # EVERY host writes its own blob (its own shard
+                        # position) — a shared primary-only header would
+                        # hand all hosts the primary's position.
+                        self.ckpts.save_mid_level_stream(
+                            level, epoch, stream, jax.process_index()
+                        )
+                        meta["train_loader_stream_hosts"] = jax.process_count()
+                self.ckpts.save_mid_level(level, epoch, self.state, meta=meta)
 
         return self.metrics.finish_level(
             level,
@@ -444,6 +440,61 @@ class PruningHarness:
                 "final_sparsity": masking.overall_sparsity(self.state.masks),
             },
         )
+
+    def _restore_train_stream(self, mid: dict, level: int) -> None:
+        """Restore the train loader's data-order state on mid-level resume.
+
+        Three tiers, degrading gracefully (never crashing the resume):
+        1. Stream-position loaders (grain): per-host tagged blob written by
+           save_mid_level_stream — each host restores ITS OWN shard
+           position. Missing/mistagged blob, changed host count, or a
+           loader that rejects the state (e.g. num_workers changed) falls
+           through to tier 3 with a warning.
+        2. (seed, epoch)-stateless loaders (device/tpk/synthetic): the
+           epoch counter IS the state; restoring it is bit-exact.
+        3. Fallback: fresh shuffle pass — statistically equivalent, loudly
+           not bit-identical."""
+        train_loader = self.loaders.train_loader
+        epoch = mid["train_loader_epoch"]
+        if mid.get("train_loader_stream_hosts") and hasattr(
+            train_loader, "set_stream_state"
+        ):
+            blob = None
+            if mid["train_loader_stream_hosts"] == jax.process_count():
+                blob = self.ckpts.load_mid_level_stream(
+                    level, mid["epoch"], jax.process_index()
+                )
+            if blob is not None:
+                try:
+                    train_loader.set_stream_state(blob)
+                    if hasattr(train_loader, "epoch"):
+                        train_loader.epoch = epoch
+                    return
+                except Exception as e:  # incompatible state: degrade, don't die
+                    if is_primary():
+                        print(
+                            f"[resume] stream state rejected ({e!r:.200}); "
+                            "falling back to a fresh shuffle pass",
+                            flush=True,
+                        )
+            elif is_primary():
+                print(
+                    "[resume] stream-state blob missing or from a different "
+                    "save/host-count; falling back to a fresh shuffle pass",
+                    flush=True,
+                )
+        elif getattr(train_loader, "resumable_epochs", True) and hasattr(
+            train_loader, "epoch"
+        ):
+            train_loader.epoch = epoch
+            return
+        if is_primary():
+            print(
+                "[resume] WARNING: the resumed run sees a fresh shuffle "
+                "pass — statistically equivalent, NOT bit-identical to an "
+                "uninterrupted run",
+                flush=True,
+            )
 
     def _log_console(self, row: dict) -> None:
         print(

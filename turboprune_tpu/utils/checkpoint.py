@@ -238,6 +238,41 @@ class ExperimentCheckpoints:
             return None
         return restored
 
+    # Stream-position loaders (grain): each host's iterator state is ITS
+    # OWN shard position, so blobs are per-host files (unique paths — no
+    # cross-host write conflict, unlike the shared JSON header which is
+    # primary-only and would silently hand every host the primary's
+    # position). An 8-byte (level, epoch) tag prefixes the blob so a
+    # preemption between the state save and the stream write cannot pair a
+    # stale stream with a newer state — the loader falls back to a fresh
+    # pass instead.
+
+    def _mid_level_stream_path(self, pid: int) -> Path:
+        return self.checkpoints_dir / f"mid_level_stream_{pid}"
+
+    def save_mid_level_stream(
+        self, level: int, epoch: int, blob: bytes, pid: int
+    ) -> None:
+        tag = (level * 1_000_000 + epoch).to_bytes(8, "big")
+        p = self._mid_level_stream_path(pid)
+        tmp = p.with_suffix(".tmp")
+        tmp.write_bytes(tag + blob)
+        tmp.replace(p)
+
+    def load_mid_level_stream(
+        self, level: int, epoch: int, pid: int
+    ) -> Optional[bytes]:
+        """The blob, or None when absent / tagged for a different save."""
+        p = self._mid_level_stream_path(pid)
+        if not p.exists():
+            return None
+        raw = p.read_bytes()
+        if len(raw) < 8 or int.from_bytes(raw[:8], "big") != (
+            level * 1_000_000 + epoch
+        ):
+            return None
+        return raw[8:]
+
     def clear_mid_level(self) -> None:
         """Drop the slot (primary-only). Called whenever training reaches a
         level the slot does not belong to: levels run in ascending order, so
@@ -253,6 +288,8 @@ class ExperimentCheckpoints:
             self._mid_level_meta_path().unlink(missing_ok=True)
             if self.mid_level_path().exists():
                 shutil.rmtree(self.mid_level_path())
+            for p in self.checkpoints_dir.glob("mid_level_stream_*"):
+                p.unlink(missing_ok=True)
         sync_hosts("mid_level_clear")
 
     # --- optimizer roles --------------------------------------------------
