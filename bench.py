@@ -230,16 +230,49 @@ def bench_tpk_decode(split: Path, root: Path, batch: int = 256) -> float:
 
 
 def bench_grain_decode(split: Path, batch: int = 256, workers: int = 2) -> float:
-    from turboprune_tpu.data.imagenet import GrainImageLoader
+    """Measured in a CPU-pinned SUBPROCESS: grain's ShardByJaxProcess
+    queries the JAX backend, and on a dead axon tunnel even that first
+    backend touch hangs forever — but the quantity measured here is pure
+    host decode throughput, which has nothing to do with the accelerator.
+    Pinning the subprocess to the CPU platform makes the stage
+    tunnel-independent."""
+    import subprocess
 
-    loader = GrainImageLoader(
-        str(split), total_batch_size=batch, train=True, num_workers=workers
+    code = f"""
+import time
+import jax
+jax.config.update("jax_platforms", "cpu")
+from turboprune_tpu.data.imagenet import GrainImageLoader
+
+loader = GrainImageLoader(
+    {str(split)!r}, total_batch_size={batch}, train=True, num_workers={workers}
+)
+n, t = 0, 0.0
+for e in range(3):
+    t0 = time.perf_counter()
+    count = sum(images.shape[0] for images, _ in loader._raw_batches())
+    dt = time.perf_counter() - t0
+    if e > 0:
+        n += count
+        t += dt
+print("RATE", n / t)
+"""
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        cwd=str(Path(__file__).resolve().parent),
+        # Must sit UNDER the 480s stage watchdog: TimeoutExpired kills the
+        # child cleanly, whereas the watchdog's os._exit would orphan the
+        # decoder (and its grain workers) onto the next retry's CPU.
+        timeout=420,
     )
-
-    def one_epoch(e: int) -> int:
-        return sum(images.shape[0] for images, _ in loader._raw_batches())
-
-    return _steady_epochs(one_epoch)
+    for line in out.stdout.splitlines():
+        if line.startswith("RATE "):
+            return float(line.split()[1])
+    raise RuntimeError(
+        f"grain decode subprocess failed: {out.stderr[-400:]}"
+    )
 
 
 def bench_fed_resnet50(split: Path, root: Path, batch: int = BATCH_FED) -> float:
@@ -276,7 +309,7 @@ def bench_flash_attention() -> dict:
     committed proof that Mosaic lowering works outside interpret mode
     (VERDICT r4 missing #5). deit_small-shaped heads (6 x 64) at S=1024,
     batch 8 -> [48, 1024, 64]."""
-    if jax.default_backend() != "tpu":
+    if jax.default_backend() not in ("tpu", "axon"):
         raise RuntimeError("flash bench requires the real TPU backend")
     from turboprune_tpu.ops.flash import flash_attention
 
@@ -385,6 +418,38 @@ def _arm_watchdog(seconds: int = 480) -> None:
     _watchdog = t
 
 
+def _tpu_reachable(timeout_s: int = 180) -> bool:
+    """Probe the device in a SUBPROCESS with a hard timeout: on the axon
+    tunnel even jax.devices() can hang forever, and a hung probe in-process
+    would trip the watchdog before the HOST-ONLY stages (tpk/grain decode)
+    ever ran. When the probe fails, device stages are skipped this run
+    (left uncached — a later run with the tunnel up fills them) and the
+    host stages still execute."""
+    import subprocess
+
+    code = (
+        "import jax, jax.numpy as jnp;"
+        "x = (jnp.zeros(4) + 1).sum();"
+        "assert float(x) == 4.0;"
+        "print(jax.default_backend())"
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=timeout_s,
+            capture_output=True,
+            text=True,
+        )
+        # Require a REAL accelerator backend ("tpu", or "axon" — the
+        # tunnel's platform name): when plugin init fails fast, jax
+        # silently falls back to CPU, the tiny op succeeds, and the device
+        # stages would then run on a 1-core host straight into the
+        # watchdog — the exact failure this probe exists to prevent.
+        return out.returncode == 0 and out.stdout.strip() in ("tpu", "axon")
+    except subprocess.TimeoutExpired:
+        return False
+
+
 # ------------------------------------------------------- stage persistence
 def _load_stage_cache(path: Path) -> dict:
     try:
@@ -443,12 +508,33 @@ def main() -> None:
         return fields
 
     _arm_watchdog()
+    # Device stages only when the chip answers a subprocess probe — a dead
+    # tunnel must not stop the HOST-ONLY decode stages from caching.
+    device_stages = {"resnet18", "resnet50", "flash_attention", "fed_resnet50"}
+    if not force and all(s in cache for s in device_stages):
+        tpu_ok = True  # everything device-side is already cached
+    else:
+        _log("probing device reachability...")
+        tpu_ok = _tpu_reachable()
+        _log(
+            "device probe: "
+            + ("ok" if tpu_ok else "UNREACHABLE — skipping device stages")
+        )
+    if not tpu_ok:
+        extra["device_probe"] = "unreachable; device stages skipped this run"
+
+    def run_device_stage(name: str, fn):
+        if not tpu_ok:
+            if name in hits:
+                return run_stage(name, fn)  # replay the cached value
+            return None  # unreachable and nothing cached — skip this run
+        return run_stage(name, fn)
 
     def stage_r18() -> dict:
         img, _ = bench_train("resnet18", BATCH_R18)
         return {"resnet18_img_per_sec": round(img, 1)}
 
-    r18 = run_stage("resnet18", stage_r18)
+    r18 = run_device_stage("resnet18", stage_r18)
     img_r18 = (r18 or {}).get("resnet18_img_per_sec", 0.0)
     _partial["img_r18"] = img_r18
 
@@ -469,8 +555,8 @@ def main() -> None:
                 fields["chip_peak_tflops"] = peak
         return fields
 
-    run_stage("resnet50", stage_r50)
-    run_stage("flash_attention", bench_flash_attention)
+    run_device_stage("resnet50", stage_r50)
+    run_device_stage("flash_attention", bench_flash_attention)
 
     # Host-pipeline stages share the JPEG dataset; build it lazily only if
     # at least one of them is not already cached.
@@ -497,7 +583,7 @@ def main() -> None:
 
     run_stage("tpk_decode", stage_tpk)
     run_stage("grain_decode", stage_grain)
-    run_stage("fed_resnet50", stage_fed)
+    run_device_stage("fed_resnet50", stage_fed)
     extra["pipeline_host_cpu_cores"] = os.cpu_count()
 
     _partial["done"] = True  # fire() checks this — cancel can lose the race

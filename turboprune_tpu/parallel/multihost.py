@@ -116,25 +116,59 @@ def tree_fingerprint(tree: PyTree) -> str:
     return h.hexdigest()
 
 
+@jax.jit
+def _leaf_moments(leaves):
+    # Module-level jit: caches per leaves-structure, so the per-prune
+    # equality check compiles once per state signature, not per call.
+    out = []
+    for x in leaves:
+        xf = jnp.asarray(x).astype(jnp.float32)
+        out.append(jnp.stack([xf.sum(), (xf * xf).sum()]))
+    return jnp.stack(out)
+
+
+def tree_moments(tree: PyTree) -> np.ndarray:
+    """Per-leaf [sum, sum-of-squares] computed ON DEVICE — a [L, 2] array is
+    all that crosses to the host (the old path pulled every leaf for
+    hashing: a full params+masks device->host transfer per prune, r4 weak
+    #8). Determinism makes this an equality check, not just a sketch: hosts
+    hold bit-identical replicated arrays and run the same compiled
+    reduction, so equal state implies exactly equal moments; divergence
+    escapes detection only if it cancels both moments of every leaf."""
+    leaves = [
+        leaf
+        for _, leaf in jax.tree_util.tree_flatten_with_path(
+            tree, is_leaf=lambda x: x is None
+        )[0]
+        if leaf is not None
+    ]
+    return np.asarray(jax.device_get(_leaf_moments(leaves)))
+
+
 def check_state_equality(tree: PyTree, what: str = "state") -> None:
     """Assert all hosts hold identical replicated state; raises on divergence.
 
     Upgrade of the reference's never-called check_model_equality
-    (distributed_utils.py:31-60): hash params+masks locally, allgather the
-    digests, compare."""
-    digest = tree_fingerprint(tree)
+    (distributed_utils.py:31-60): per-leaf device-side moments, allgathered
+    and compared bit-exactly (see tree_moments for why equality of moments
+    is the right check here). ``tree_fingerprint`` remains the exact
+    content hash for run-level evidence/tests."""
     if jax.process_count() == 1:
         return
     from jax.experimental import multihost_utils
 
-    fp = np.frombuffer(bytes.fromhex(digest), dtype=np.uint8)
-    all_fps = multihost_utils.process_allgather(fp)
-    ref = np.asarray(all_fps)[0]
-    for i, other in enumerate(np.asarray(all_fps)):
-        if not np.array_equal(ref, other):
+    m = tree_moments(tree)
+    all_m = np.asarray(multihost_utils.process_allgather(m, tiled=False))
+    ref = all_m[0]
+    for i, other in enumerate(all_m):
+        # equal_nan: hosts that ALL went NaN identically (diverged loss)
+        # have not diverged from each other — don't misreport a PRNG bug.
+        if not np.array_equal(ref, other, equal_nan=True):
+            bad = int(np.argwhere((ref != other).any(axis=-1))[0][0])
             raise RuntimeError(
-                f"{what} diverged across hosts: host 0 != host {i}. "
-                "Replicated pruning requires identical PRNG keys on every host."
+                f"{what} diverged across hosts: host 0 != host {i} "
+                f"(first differing leaf index {bad}). Replicated pruning "
+                "requires identical PRNG keys on every host."
             )
 
 
