@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Serve a pruned TurboPrune-TPU checkpoint over HTTP.
+
+Usage:
+    python run_server.py --expt-dir experiments/<dir> [serve.port=8080 ...]
+    python run_server.py serve.expt_dir=experiments/<dir> serve.checkpoint_level=3
+
+The serve group composes Hydra-style from conf/serve/ (see conf/serve.yaml);
+the model architecture and input geometry come from the experiment dir's own
+expt_config.yaml snapshot, so the served checkpoint always matches its model.
+
+Endpoints:
+    POST /predict   {"instances": [[H][W][C] floats, ...]}
+    GET  /healthz   checkpoint level/density, buckets, queue depth
+    GET  /metrics   Prometheus text (latency histogram, throughput,
+                    queue depth, compile-cache hit/miss)
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def parse_args(argv):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--config-name",
+        default="serve",
+        help="top-level config under conf/ (default: serve)",
+    )
+    parser.add_argument(
+        "--config-path", default=None, help="alternate config root directory"
+    )
+    parser.add_argument(
+        "--expt-dir",
+        default="",
+        help="experiment directory to serve (overrides serve.expt_dir)",
+    )
+    parser.add_argument(
+        "overrides",
+        nargs="*",
+        help="dotted overrides like serve.port=8080 serve.max_batch=64",
+    )
+    return parser.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv if argv is not None else sys.argv[1:])
+
+    from turboprune_tpu.config.compose import compose
+    from turboprune_tpu.serve import build_server
+
+    cfg = compose(args.config_name, args.overrides, args.config_path)
+    server = build_server(cfg, expt_dir=args.expt_dir)
+    info = server.engine.info()
+    host, port = server.server_address[:2]
+    print(
+        f"serving {info['source']}\n"
+        f"  level={info['level']} density={info['density']} "
+        f"buckets={info['buckets']} "
+        f"compiled={info['compiled_buckets']}\n"
+        f"  POST http://{host}:{port}/predict   "
+        f"GET /healthz   GET /metrics",
+        flush=True,
+    )
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        print("\nshutting down", flush=True)
+    finally:
+        server.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -247,3 +247,106 @@ class TestExperimentUtils:
         summary = pd.read_csv(tmp_path / "metrics/pfx_summary.csv")
         assert list(summary["level"]) == [0, 1]
         assert list(summary["sparsity"]) == [0.0, 20.0]
+
+
+class TestMidLevelSlotIdentity:
+    """ADVICE r5: the mid-level slot is stamped with a config hash + run id;
+    a restore under a changed config is refused (level replays instead) and
+    the driver clears the slot at run completion."""
+
+    def _cfg(self, base, *extra):
+        return compose(
+            "cifar10_imp",
+            overrides=[
+                f"experiment_params.base_dir={base}",
+                "dataset_params.dataloader_type=synthetic",
+                "dataset_params.total_batch_size=16",
+                "dataset_params.synthetic_num_train=64",
+                "dataset_params.synthetic_num_test=32",
+                "experiment_params.epochs_per_level=2",
+                "experiment_params.max_steps_per_epoch=1",
+                "experiment_params.checkpoint_every_epochs=1",
+                "pruning_params.target_sparsity=0.2",
+                *extra,
+            ],
+        )
+
+    def test_config_fingerprint_semantics(self, tmp_path):
+        from turboprune_tpu.utils import config_fingerprint
+
+        base = config_fingerprint(self._cfg(tmp_path))
+        # The resume knobs MUST NOT change the hash (a resumed run flips
+        # them and still has to match its own slot)...
+        assert (
+            config_fingerprint(
+                self._cfg(tmp_path, "experiment_params.resume_experiment=true")
+            )
+            == base
+        )
+        # ...while any training-relevant knob must.
+        assert (
+            config_fingerprint(self._cfg(tmp_path, "optimizer_params.lr=0.1"))
+            != base
+        )
+        assert (
+            config_fingerprint(
+                self._cfg(tmp_path, "experiment_params.epochs_per_level=3")
+            )
+            != base
+        )
+
+    def test_restore_refused_on_config_change_honored_on_match(self, tmp_path):
+        import pandas as pd
+
+        from turboprune_tpu.harness import PruningHarness
+        from turboprune_tpu.utils import gen_expt_dir
+
+        cfg = self._cfg(tmp_path)
+        prefix, expt_dir = gen_expt_dir(cfg)
+        save_config(expt_dir, cfg)
+        harness = PruningHarness(cfg, (prefix, expt_dir))
+        meta = {
+            "max_test_acc": 0.0,
+            "train_loader_epoch": 0,
+            "level_rows": [],
+            "run_id": harness.run_id,
+        }
+
+        # Slot stamped with a DIFFERENT config hash: refused -> the level
+        # replays from epoch 0, so the level CSV has all epochs_per_level
+        # rows (an honored restore would skip epoch 0).
+        harness.ckpts.save_mid_level(
+            0, 0, harness.state, meta={**meta, "config_hash": "bogus"}
+        )
+        harness.train_one_level(2, 0)
+        csv = (
+            f"{expt_dir}/metrics/level_wise_metrics/level_0_metrics.csv"
+        )
+        assert list(pd.read_csv(csv)["epoch"]) == [0, 1]
+
+        # Slot stamped with the MATCHING hash: honored -> re-enters at
+        # epoch 1, only one fresh row.
+        harness.ckpts.save_mid_level(
+            0, 0, harness.state,
+            meta={**meta, "config_hash": harness.config_hash},
+        )
+        harness.train_one_level(2, 0)
+        assert list(pd.read_csv(csv)["epoch"]) == [1]
+
+    def test_driver_clears_slot_at_run_completion(self, tmp_path):
+        from turboprune_tpu.driver import run
+
+        cfg = self._cfg(tmp_path)
+        expt_dir, summaries = run(cfg)
+        assert len(summaries) == 2
+        ckpts = ExperimentCheckpoints(expt_dir)
+        assert ckpts.peek_mid_level() is None
+        assert not ckpts.mid_level_path().exists()
+
+
+def test_check_state_equality_exact_single_process_noop():
+    """exact=True adds a full-fingerprint allgather on multi-host runs; on
+    one process it must remain a no-op (no device chatter in unit tests)."""
+    from turboprune_tpu.parallel import check_state_equality
+
+    check_state_equality({"a": np.ones(3, np.float32)}, exact=True)

@@ -257,6 +257,61 @@ class OptimizerConfig:
 
 
 @dataclass
+class ServeConfig:
+    """Inference-serving knobs (serve/ subsystem; composed from conf/serve/).
+
+    The model/dataset geometry is NOT configured here — the engine reads the
+    experiment dir's own ``expt_config.yaml`` snapshot, so a served
+    checkpoint can never be paired with the wrong architecture."""
+
+    # Experiment dir to serve from (or pass --expt-dir to run_server.py).
+    expt_dir: str = ""
+    # Which checkpoint: model_level_{N}; -1 = highest saved level.
+    checkpoint_level: int = -1
+    # Alternative: a role name (model_init / model_rewind). Overrides level.
+    checkpoint_role: str = ""
+    host: str = "127.0.0.1"
+    port: int = 8000
+    # Padded batch-size buckets the engine compiles for. Every request batch
+    # is padded up to the smallest bucket that fits (larger ones are split at
+    # the biggest bucket), so steady-state traffic never triggers a fresh
+    # XLA trace.
+    batch_buckets: list = field(default_factory=lambda: [1, 8, 32, 128])
+    # Dynamic micro-batching: flush when max_batch rows are waiting or the
+    # oldest request has waited max_wait_ms.
+    max_batch: int = 128
+    max_wait_ms: float = 5.0
+    # Backpressure: pending requests beyond this are rejected (HTTP 503).
+    queue_depth: int = 256
+    # Compile every bucket at startup (before the first request lands).
+    warmup: bool = True
+    request_timeout_s: float = 30.0
+
+    def validate(self) -> None:
+        if not self.batch_buckets:
+            raise ConfigError("serve.batch_buckets must be non-empty")
+        buckets = list(self.batch_buckets)
+        if any(not isinstance(b, int) or b < 1 for b in buckets):
+            raise ConfigError(
+                f"serve.batch_buckets must be positive ints, got {buckets}"
+            )
+        if buckets != sorted(set(buckets)):
+            raise ConfigError(
+                f"serve.batch_buckets must be strictly increasing, got {buckets}"
+            )
+        if self.max_batch < 1:
+            raise ConfigError("serve.max_batch must be >= 1")
+        if self.max_wait_ms < 0:
+            raise ConfigError("serve.max_wait_ms must be >= 0")
+        if self.queue_depth < 1:
+            raise ConfigError("serve.queue_depth must be >= 1")
+        if not (0 <= self.port <= 65535):
+            raise ConfigError("serve.port must be in [0, 65535] (0 = ephemeral)")
+        if self.request_timeout_s <= 0:
+            raise ConfigError("serve.request_timeout_s must be positive")
+
+
+@dataclass
 class CyclicTrainingConfig:
     num_cycles: int = 1
     strategy: str = "constant"
@@ -277,6 +332,9 @@ class MainConfig:
     cyclic_training: CyclicTrainingConfig = field(
         default_factory=CyclicTrainingConfig
     )
+    # Inference serving (run_server.py); optional — training configs don't
+    # carry it, serving composes it from the conf/serve/ group.
+    serve: Optional[ServeConfig] = None
 
     def validate(self) -> "MainConfig":
         for f in fields(self):
@@ -387,6 +445,7 @@ _NESTED = {
     "OptimizerConfig": OptimizerConfig,
     "CyclicTrainingConfig": CyclicTrainingConfig,
     "ResumeExperimentConfig": ResumeExperimentConfig,
+    "ServeConfig": ServeConfig,
 }
 
 

@@ -99,8 +99,12 @@ def prune_level(harness, density: float, level: int) -> None:
         cfg.pruning_params.training_type, harness.state, harness.ckpts
     )
     if jax.process_count() > 1:
+        # Once per level, so the exact digest allgather (full device->host
+        # transfer; catches element-permuting divergence the cheap moments
+        # check cannot) stays off the per-step path.
         check_state_equality(
-            {"params": harness.state.params, "masks": harness.state.masks}
+            {"params": harness.state.params, "masks": harness.state.masks},
+            exact=True,
         )
 
 
@@ -163,6 +167,12 @@ def run(cfg: MainConfig, harness_cls: Optional[Type[PruningHarness]] = None):
         achieved = masking.overall_density(harness.state.masks)
         summary["achieved_density"] = achieved
         summaries.append(summary)
+    if ep.checkpoint_every_epochs:
+        # Run complete: the final level's mid-level slot is stale — left
+        # behind it would hijack a later resume of this dir after a config
+        # change (its embedded config hash defends too; this removes the
+        # hazard outright).
+        harness.ckpts.clear_mid_level()
     harness.wandb.finish()
     return expt_dir, summaries
 

@@ -12,6 +12,7 @@ call into its schedule generator is broken for num_cycles>1
 
 from __future__ import annotations
 
+from ..config.schema import ConfigError
 from ..ops import masking
 from ..pruning import generate_cyclical_schedule
 from ..utils import MODEL_INIT, MODEL_REWIND, OPTIMIZER_INIT, OPTIMIZER_REWIND
@@ -20,6 +21,20 @@ from .pruning_harness import PruningHarness
 
 
 class CyclicPruningHarness(PruningHarness):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        if self.cfg.experiment_params.checkpoint_every_epochs:
+            # The cyclic level loop below fully overrides the base harness's
+            # and has no mid-level re-entry: accepting the knob would
+            # silently provide NO preemption protection.
+            raise ConfigError(
+                "experiment_params.checkpoint_every_epochs > 0 is not "
+                "supported with cyclic training — the cyclic loop cannot "
+                "resume mid-level, so the setting would be a silent no-op. "
+                "Set checkpoint_every_epochs=0 (level-granular resume still "
+                "works)."
+            )
+
     def train_one_level(
         self, epochs_per_level: int, level: int, num_cycles: int = 0
     ) -> dict:

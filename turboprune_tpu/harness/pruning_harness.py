@@ -27,6 +27,7 @@ subsequent levels (SURVEY.md §7 "Recompile hazards").
 from __future__ import annotations
 
 import time
+from pathlib import Path
 from typing import Any, Optional
 
 import jax
@@ -66,6 +67,7 @@ from ..utils import (
     OPTIMIZER_REWIND,
     ExperimentCheckpoints,
     MetricsLogger,
+    config_fingerprint,
     display_training_info,
 )
 from ..utils.wandb_logging import WandbRun
@@ -115,6 +117,11 @@ class PruningHarness:
                 f"data-axis size — adjust total_batch_size or num_devices"
             )
         self.ckpts = ExperimentCheckpoints(self.expt_dir)
+        # Identity stamps for the mid-level slot: a slot whose config hash
+        # disagrees with the live config is never restored (it holds
+        # mid-trajectory state trained under different knobs).
+        self.config_hash = config_fingerprint(cfg)
+        self.run_id = Path(self.expt_dir).name if self.expt_dir else ""
         self.metrics = MetricsLogger(self.expt_dir, self.prefix)
         self.wandb = WandbRun(cfg, self.prefix, self.expt_dir)
 
@@ -336,7 +343,23 @@ class PruningHarness:
         max_test_acc = 0.0
         start_epoch = 0
         mid = self.ckpts.peek_mid_level() if ckpt_every else None
-        if mid and mid["level"] != level:
+        if mid and mid.get("config_hash") != self.config_hash:
+            # Identity mismatch (or a pre-stamp slot of unknown provenance):
+            # the slot holds mid-trajectory state trained under a DIFFERENT
+            # config (lr, epoch budget, loader type, ...) — restoring it
+            # would silently continue the wrong trajectory. Refuse and
+            # replay the level from its start.
+            if is_primary():
+                print(
+                    "[resume] REFUSING mid-level restore: slot config hash "
+                    f"{mid.get('config_hash')!r} != current "
+                    f"{self.config_hash!r} (run {mid.get('run_id')!r}) — "
+                    "the config changed since the slot was written; "
+                    "replaying the level from its start",
+                    flush=True,
+                )
+            self.ckpts.clear_mid_level()
+        elif mid and mid["level"] != level:
             # Levels run in ascending order, so a slot for a different level
             # is always from an abandoned trajectory (e.g. resumed BELOW a
             # preempted level) — drop it before it can hijack a later
@@ -411,6 +434,10 @@ class PruningHarness:
             ):
                 meta = {
                     "max_test_acc": max_test_acc,
+                    # Slot identity (ADVICE r5): the restore path refuses a
+                    # slot whose config hash disagrees with the live run.
+                    "config_hash": self.config_hash,
+                    "run_id": self.run_id,
                     "train_loader_epoch": getattr(
                         self.loaders.train_loader, "epoch", 0
                     ),

@@ -42,6 +42,23 @@ def _cluster_hinted() -> bool:
     return "," in os.environ.get("TPU_WORKER_HOSTNAMES", "")
 
 
+def _distributed_initialized() -> bool:
+    """True when the jax distributed service is already up.
+
+    ``jax.distributed.is_initialized`` only exists on newer jax; on older
+    releases (e.g. the 0.4.37 in this image) fall back to the client handle
+    on the internal global state — the same thing is_initialized reads."""
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None:
+        return bool(is_init())
+    try:
+        from jax._src.distributed import global_state
+
+        return global_state.client is not None
+    except Exception:
+        return False
+
+
 def initialize_distributed() -> None:
     """Join the multi-host world when launched under a JAX cluster
     (coordinator env vars / TPU metadata present); no-op single-host.
@@ -56,7 +73,7 @@ def initialize_distributed() -> None:
     deciding, and calls ``jax.distributed.initialize`` before anything else
     queries the runtime. Regression-tested via tests/mp_worker.py, which
     joins its 2-process world through this exact entry path."""
-    if jax.distributed.is_initialized():
+    if _distributed_initialized():
         return  # already joined (e.g. a direct jax.distributed.initialize)
     coord = os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
         "COORDINATOR_ADDRESS"
@@ -145,14 +162,21 @@ def tree_moments(tree: PyTree) -> np.ndarray:
     return np.asarray(jax.device_get(_leaf_moments(leaves)))
 
 
-def check_state_equality(tree: PyTree, what: str = "state") -> None:
+def check_state_equality(
+    tree: PyTree, what: str = "state", exact: bool = False
+) -> None:
     """Assert all hosts hold identical replicated state; raises on divergence.
 
     Upgrade of the reference's never-called check_model_equality
     (distributed_utils.py:31-60): per-leaf device-side moments, allgathered
     and compared bit-exactly (see tree_moments for why equality of moments
-    is the right check here). ``tree_fingerprint`` remains the exact
-    content hash for run-level evidence/tests."""
+    is the right check here). Moments are permutation-invariant, though — a
+    divergence that permutes elements within a leaf (or cancels both
+    moments) slips past them — so ``exact=True`` ADDITIONALLY allgathers
+    the full ``tree_fingerprint`` digest (a complete device->host transfer;
+    the driver pays it once per level, not per step). The cheap moments
+    check still runs first: when it fires it names the first differing
+    leaf, which the opaque digest cannot."""
     if jax.process_count() == 1:
         return
     from jax.experimental import multihost_utils
@@ -170,6 +194,20 @@ def check_state_equality(tree: PyTree, what: str = "state") -> None:
                 f"(first differing leaf index {bad}). Replicated pruning "
                 "requires identical PRNG keys on every host."
             )
+    if exact:
+        digest = np.frombuffer(
+            bytes.fromhex(tree_fingerprint(tree)), dtype=np.uint8
+        )
+        all_d = np.asarray(
+            multihost_utils.process_allgather(digest, tiled=False)
+        )
+        for i, other in enumerate(all_d):
+            if not np.array_equal(all_d[0], other):
+                raise RuntimeError(
+                    f"{what} diverged across hosts: host 0 != host {i} "
+                    "(exact content-hash mismatch despite equal per-leaf "
+                    "moments — an element-permuting divergence)."
+                )
 
 
 def sync_hosts(name: str = "barrier") -> None:

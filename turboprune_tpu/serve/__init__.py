@@ -1,0 +1,29 @@
+"""Inference serving for pruned checkpoints (beyond-reference subsystem).
+
+engine.py   InferenceEngine — checkpoint loading, mask folding, AOT
+            compiled-shape cache over padded batch-size buckets
+batcher.py  DynamicBatcher — deadline/size micro-batching with bounded-queue
+            backpressure
+metrics.py  ServeMetrics — latency histogram, counters, gauges, Prometheus
+            text exposition
+server.py   InferenceServer — stdlib HTTP /predict /healthz /metrics
+
+Entry point: run_server.py at the repo root, configured by the conf/serve/
+group composed through config/compose.py.
+"""
+
+from .batcher import DynamicBatcher, QueueFullError
+from .engine import DEFAULT_BUCKETS, InferenceEngine
+from .metrics import LATENCY_BUCKETS_MS, ServeMetrics
+from .server import InferenceServer, build_server
+
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "DynamicBatcher",
+    "InferenceEngine",
+    "InferenceServer",
+    "LATENCY_BUCKETS_MS",
+    "QueueFullError",
+    "ServeMetrics",
+    "build_server",
+]

@@ -1,0 +1,167 @@
+"""DynamicBatcher — micro-batching queue between callers and the engine.
+
+Requests (each a [k, H, W, C] float array, k >= 1) land on a BOUNDED queue
+(backpressure: a full queue rejects with QueueFullError so the HTTP layer
+can answer 503 instead of building an unbounded backlog). One worker thread
+drains it: a batch opens when the first request is picked up and flushes
+when either ``max_batch`` rows are waiting or ``max_wait_ms`` has elapsed
+since the batch opened — the classic deadline/size dynamic-batching policy.
+The concatenated rows go through ``engine.predict`` (which pads to the
+compiled bucket) and each caller's Future receives exactly its own rows
+back.
+
+Latency recorded per request is submit -> result (queue wait + batching
+wait + padded forward), i.e. what a caller actually experiences.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Optional
+
+import numpy as np
+
+
+class QueueFullError(RuntimeError):
+    """Bounded request queue is full — shed load (HTTP 503)."""
+
+
+class _Request:
+    __slots__ = ("images", "future", "t_submit")
+
+    def __init__(self, images: np.ndarray, future: Future, t_submit: float):
+        self.images = images
+        self.future = future
+        self.t_submit = t_submit
+
+
+class DynamicBatcher:
+    def __init__(
+        self,
+        engine,
+        *,
+        max_batch: int = 128,
+        max_wait_ms: float = 5.0,
+        queue_depth: int = 256,
+        metrics=None,
+    ):
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self.engine = engine
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        self.metrics = metrics
+        self._queue: queue.Queue[_Request] = queue.Queue(maxsize=queue_depth)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> "DynamicBatcher":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="turboprune-batcher", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def close(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        # Fail any stragglers instead of leaving callers blocked forever.
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            req.future.set_exception(RuntimeError("batcher closed"))
+
+    @property
+    def queue_depth(self) -> int:
+        return self._queue.qsize()
+
+    # ------------------------------------------------------------- clients
+    def submit(self, images: np.ndarray) -> Future:
+        """Enqueue one request; returns a Future resolving to its logits.
+        Raises QueueFullError when the bounded queue is at capacity."""
+        x = np.asarray(images, np.float32)
+        if x.ndim == len(self.engine.input_shape):
+            x = x[None]
+        if (
+            x.ndim != len(self.engine.input_shape) + 1
+            or x.shape[1:] != self.engine.input_shape
+            or x.shape[0] == 0
+        ):
+            raise ValueError(
+                f"expected [k, {', '.join(map(str, self.engine.input_shape))}]"
+                f" with k >= 1, got {x.shape}"
+            )
+        req = _Request(x, Future(), time.perf_counter())
+        try:
+            self._queue.put_nowait(req)
+        except queue.Full:
+            if self.metrics:
+                self.metrics.inc("rejected_total")
+            raise QueueFullError(
+                f"request queue full ({self._queue.maxsize} pending)"
+            ) from None
+        if self.metrics:
+            self.metrics.inc("requests_total")
+            self.metrics.set_gauge("queue_depth", self._queue.qsize())
+        return req.future
+
+    def predict(self, images: np.ndarray, timeout: float = 30.0) -> np.ndarray:
+        return self.submit(images).result(timeout)
+
+    # -------------------------------------------------------------- worker
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                first = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            batch = [first]
+            rows = first.images.shape[0]
+            deadline = time.perf_counter() + self.max_wait_s
+            while rows < self.max_batch:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                batch.append(nxt)
+                rows += nxt.images.shape[0]
+            if self.metrics:
+                self.metrics.set_gauge("queue_depth", self._queue.qsize())
+            self._flush(batch, rows)
+
+    def _flush(self, batch: list[_Request], rows: int) -> None:
+        images = (
+            batch[0].images
+            if len(batch) == 1
+            else np.concatenate([r.images for r in batch])
+        )
+        try:
+            logits = self.engine.predict(images)
+        except Exception as e:  # surface to every caller, keep serving
+            if self.metrics:
+                self.metrics.inc("errors_total", len(batch))
+            for req in batch:
+                req.future.set_exception(e)
+            return
+        done = time.perf_counter()
+        offset = 0
+        for req in batch:
+            k = req.images.shape[0]
+            req.future.set_result(logits[offset : offset + k])
+            offset += k
+            if self.metrics:
+                self.metrics.observe_latency_ms((done - req.t_submit) * 1e3)
+        if self.metrics:
+            self.metrics.observe_batch(rows)

@@ -1,0 +1,253 @@
+"""InferenceEngine — pruned-checkpoint forward with a compiled-shape cache.
+
+Loads any experiment-dir checkpoint (``model_level_{L}`` or a role like
+``model_init``) next to the experiment's own ``expt_config.yaml`` snapshot,
+so a served checkpoint can never be paired with the wrong architecture.
+Masks are folded into the weights ONCE at load time (``w * m`` is exact in
+fp32, so the folded forward is bit-identical to the training path's
+apply-masks-inside-jit forward — asserted in tests/test_serve.py), and the
+forward is AOT-compiled per padded batch-size bucket: a request for n rows
+is padded up to the smallest bucket >= n (split at the largest bucket), so
+at steady state no request ever triggers a fresh XLA trace. Compile-cache
+hits/misses are reported through ServeMetrics.
+
+Serving is single-process/single-program by design — the training-side mesh
+machinery (sharded steps, multihost barriers) is deliberately not involved;
+model-parallel attention impls (ring) fall back to their dense equivalent,
+which has an identical param tree (README "Long context / SP").
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from pathlib import Path
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import yaml
+
+from ..config.schema import config_from_dict
+from ..models import create_model
+from ..ops import masking
+from ..train.state import init_variables
+from ..utils.checkpoint import ExperimentCheckpoints, restore_pytree
+
+DEFAULT_BUCKETS = (1, 8, 32, 128)
+
+
+class InferenceEngine:
+    """Bucketed, mask-folded forward over a loaded checkpoint.
+
+    ``predict`` is thread-safe: compilation is serialized behind a lock and
+    XLA executables are themselves safe to invoke concurrently."""
+
+    def __init__(
+        self,
+        model,
+        params,
+        masks,
+        batch_stats,
+        *,
+        input_shape: Sequence[int],
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        metrics=None,
+        level: Optional[int] = None,
+        source: str = "",
+    ):
+        self.model = model
+        self.buckets = tuple(sorted({int(b) for b in buckets}))
+        if not self.buckets or self.buckets[0] < 1:
+            raise ValueError(f"buckets must be positive ints, got {buckets!r}")
+        self.input_shape = tuple(int(d) for d in input_shape)
+        self.metrics = metrics
+        self.level = level
+        self.source = source
+        self.density = masking.overall_density(masks)
+        # Fold once: pruned weights become literal zeros in the served
+        # params, so per-request forwards skip the mask multiply entirely.
+        folded = masking.apply_masks(params, masks)
+        self._variables = {"params": folded}
+        if batch_stats:
+            self._variables["batch_stats"] = batch_stats
+        self.num_classes = None  # set by the first compile (output aval)
+        self._compiled: dict[int, Any] = {}
+        self._compile_lock = threading.Lock()
+
+    # ----------------------------------------------------------- compiling
+    def _apply(self, variables, images):
+        return self.model.apply(variables, images, train=False)
+
+    def _executable(self, bucket: int):
+        """Compiled forward for one bucket shape; AOT via jit.lower so the
+        trace happens exactly once per bucket per process."""
+        fn = self._compiled.get(bucket)
+        if fn is not None:
+            if self.metrics:
+                self.metrics.compile_hit()
+            return fn
+        with self._compile_lock:
+            fn = self._compiled.get(bucket)
+            if fn is not None:  # lost the race: someone compiled it already
+                if self.metrics:
+                    self.metrics.compile_hit()
+                return fn
+            if self.metrics:
+                self.metrics.compile_miss()
+            spec = jax.ShapeDtypeStruct(
+                (bucket, *self.input_shape), jnp.float32
+            )
+            t0 = time.perf_counter()
+            lowered = jax.jit(self._apply).lower(self._variables, spec)
+            fn = lowered.compile()
+            if self.metrics:
+                self.metrics.inc(
+                    "compile_seconds_total", time.perf_counter() - t0
+                )
+            if self.num_classes is None:
+                out = jax.tree.leaves(lowered.out_info)[0]
+                self.num_classes = int(out.shape[-1])
+            self._compiled[bucket] = fn
+        return fn
+
+    def warmup(self) -> None:
+        """Compile every bucket up front (misses counted; later traffic is
+        then all cache hits — the zero-steady-state-recompile property)."""
+        for b in self.buckets:
+            self._executable(b)
+
+    @property
+    def compiled_buckets(self) -> tuple[int, ...]:
+        return tuple(sorted(self._compiled))
+
+    # ----------------------------------------------------------- inference
+    def predict(self, images: np.ndarray) -> np.ndarray:
+        """Logits for a [n, H, W, C] float batch (or one [H, W, C] image),
+        any n >= 1. Pads to the bucket internally; returns exactly n rows of
+        float32 logits — padded rows never leak (rows are independent under
+        eval-mode BatchNorm, asserted in tests)."""
+        x = np.asarray(images, np.float32)
+        if x.ndim == len(self.input_shape):
+            x = x[None]
+        if x.ndim != len(self.input_shape) + 1 or x.shape[1:] != self.input_shape:
+            raise ValueError(
+                f"expected images of shape [n, {', '.join(map(str, self.input_shape))}]"
+                f" (or one unbatched image), got {x.shape}"
+            )
+        n = x.shape[0]
+        if n == 0:
+            raise ValueError("empty batch")
+        max_b = self.buckets[-1]
+        outs = [
+            self._predict_chunk(x[off : off + max_b])
+            for off in range(0, n, max_b)
+        ]
+        return outs[0] if len(outs) == 1 else np.concatenate(outs)
+
+    def _predict_chunk(self, chunk: np.ndarray) -> np.ndarray:
+        k = chunk.shape[0]
+        bucket = self.buckets[bisect.bisect_left(self.buckets, k)]
+        if bucket > k:
+            pad = np.zeros((bucket - k, *self.input_shape), np.float32)
+            chunk = np.concatenate([chunk, pad])
+            if self.metrics:
+                self.metrics.inc("padded_rows_total", bucket - k)
+        logits = self._executable(bucket)(self._variables, chunk)
+        return np.asarray(jax.device_get(logits), np.float32)[:k]
+
+    def info(self) -> dict:
+        return {
+            "level": self.level,
+            "density": round(float(self.density), 6),
+            "buckets": list(self.buckets),
+            "compiled_buckets": list(self.compiled_buckets),
+            "input_shape": list(self.input_shape),
+            "num_classes": self.num_classes,
+            "source": self.source,
+        }
+
+    # -------------------------------------------------------- construction
+    @classmethod
+    def from_experiment(
+        cls,
+        expt_dir: str | Path,
+        *,
+        level: Optional[int] = None,
+        role: str = "",
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        metrics=None,
+        precision: Optional[str] = None,
+    ) -> "InferenceEngine":
+        """Build from an experiment directory written by the driver.
+
+        ``level=None`` / ``level=-1`` serves the highest saved
+        ``model_level_{L}``; ``role`` (e.g. ``model_init``) overrides level.
+        ``precision`` overrides the experiment's training_precision for the
+        serving forward (default: serve with the training dtype, which keeps
+        served logits bit-identical to the harness evaluate forward)."""
+        from ..harness.pruning_harness import PRECISION_DTYPES
+
+        expt_dir = Path(expt_dir)
+        cfg_path = expt_dir / "expt_config.yaml"
+        if not cfg_path.exists():
+            raise FileNotFoundError(
+                f"{cfg_path} not found — is {expt_dir} an experiment dir "
+                "written by run_experiment.py?"
+            )
+        cfg = config_from_dict(yaml.safe_load(cfg_path.read_text()))
+        dp = cfg.dataset_params
+        dtype = PRECISION_DTYPES[
+            precision or cfg.experiment_params.training_precision
+        ]
+        # Serving is single-device: ring (sequence-parallel) falls back to
+        # the param-identical dense attention path.
+        attention_impl = cfg.model_params.attention_impl
+        if attention_impl == "ring":
+            attention_impl = "dense"
+        model = create_model(
+            cfg.model_params.model_name,
+            num_classes=dp.num_classes,
+            dataset_name=dp.dataset_name,
+            compute_dtype=dtype,
+            attention_impl=attention_impl,
+        )
+        input_shape = (dp.image_size, dp.image_size, 3)
+        variables = init_variables(
+            model, jax.random.PRNGKey(0), (1, *input_shape)
+        )
+        like = {
+            "params": variables["params"],
+            "masks": masking.make_masks(variables["params"]),
+            "batch_stats": variables.get("batch_stats", {}),
+        }
+        ckpts = ExperimentCheckpoints(expt_dir)
+        if role:
+            path = ckpts.model_path(role)
+            level = None
+        else:
+            if level is None or level < 0:
+                saved = ckpts.saved_levels()
+                if not saved:
+                    raise FileNotFoundError(
+                        f"no model_level_* checkpoints under "
+                        f"{ckpts.checkpoints_dir}"
+                    )
+                level = saved[-1]
+            path = ckpts.level_path(level)
+        if not path.exists():
+            raise FileNotFoundError(f"checkpoint {path} does not exist")
+        restored = restore_pytree(path, like)
+        return cls(
+            model,
+            restored["params"],
+            restored["masks"],
+            restored["batch_stats"],
+            input_shape=input_shape,
+            buckets=buckets,
+            metrics=metrics,
+            level=level,
+            source=str(path),
+        )

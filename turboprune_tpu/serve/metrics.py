@@ -1,0 +1,147 @@
+"""Serving metrics: latency histograms, throughput counters, gauges, and
+compile-cache stats, exportable as Prometheus text exposition format.
+
+One ``ServeMetrics`` instance is shared by the engine (compile-cache
+hits/misses), the batcher (request/image counters, batch sizes, queue
+depth, per-request latency), and the HTTP server (the /metrics endpoint).
+All mutation goes through one lock — the batcher worker, N HTTP handler
+threads, and the engine's compile path all write concurrently.
+
+Quantiles (p50/p99) are computed from a bounded sliding window of recent
+latencies rather than from the histogram buckets: the window gives exact
+recent-traffic quantiles for the JSON snapshot/bench, while the cumulative
+buckets remain the long-horizon Prometheus view (scrapers compute their own
+quantiles via histogram_quantile).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from collections import deque
+from typing import Optional
+
+# Upper bounds (ms) of the cumulative latency histogram; +Inf is implicit.
+LATENCY_BUCKETS_MS = (
+    1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0, 2500.0,
+)
+
+_PREFIX = "turboprune_serve_"
+
+
+class ServeMetrics:
+    def __init__(self, window: int = 4096):
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = {}
+        self._gauges: dict[str, float] = {}
+        # counts[i] = observations <= LATENCY_BUCKETS_MS[i]; last slot = +Inf.
+        self._latency_counts = [0] * (len(LATENCY_BUCKETS_MS) + 1)
+        self._latency_sum_ms = 0.0
+        self._latency_total = 0
+        self._latency_window: deque[float] = deque(maxlen=window)
+        self._batch_window: deque[int] = deque(maxlen=window)
+
+    # ------------------------------------------------------------ mutation
+    def inc(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0.0) + value
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def compile_hit(self) -> None:
+        self.inc("compile_cache_hits_total")
+
+    def compile_miss(self) -> None:
+        self.inc("compile_cache_misses_total")
+
+    def observe_latency_ms(self, ms: float) -> None:
+        with self._lock:
+            i = bisect.bisect_left(LATENCY_BUCKETS_MS, ms)
+            self._latency_counts[i] += 1
+            self._latency_sum_ms += ms
+            self._latency_total += 1
+            self._latency_window.append(ms)
+
+    def observe_batch(self, rows: int) -> None:
+        with self._lock:
+            self._counters["batches_total"] = (
+                self._counters.get("batches_total", 0.0) + 1
+            )
+            self._counters["images_total"] = (
+                self._counters.get("images_total", 0.0) + rows
+            )
+            self._batch_window.append(int(rows))
+
+    # ------------------------------------------------------------- queries
+    def counter(self, name: str) -> float:
+        with self._lock:
+            return self._counters.get(name, 0.0)
+
+    def latency_quantile_ms(self, q: float) -> Optional[float]:
+        """Exact quantile over the recent-latency window; None when empty."""
+        with self._lock:
+            data = sorted(self._latency_window)
+        if not data:
+            return None
+        idx = min(len(data) - 1, max(0, round(q * (len(data) - 1))))
+        return data[idx]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            batch_window = list(self._batch_window)
+            total = self._latency_total
+            lat_sum = self._latency_sum_ms
+        snap = {**counters, **gauges}
+        snap["latency_observations"] = total
+        if total:
+            snap["latency_mean_ms"] = lat_sum / total
+        for q, name in ((0.5, "p50_ms"), (0.9, "p90_ms"), (0.99, "p99_ms")):
+            v = self.latency_quantile_ms(q)
+            if v is not None:
+                snap[f"latency_{name}"] = v
+        if batch_window:
+            snap["mean_batch_rows"] = sum(batch_window) / len(batch_window)
+        return snap
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition (version 0.0.4)."""
+        with self._lock:
+            counters = sorted(self._counters.items())
+            gauges = sorted(self._gauges.items())
+            counts = list(self._latency_counts)
+            lat_sum = self._latency_sum_ms
+            total = self._latency_total
+        lines = []
+        for name, value in counters:
+            lines.append(f"# TYPE {_PREFIX}{name} counter")
+            lines.append(f"{_PREFIX}{name} {_fmt(value)}")
+        for name, value in gauges:
+            lines.append(f"# TYPE {_PREFIX}{name} gauge")
+            lines.append(f"{_PREFIX}{name} {_fmt(value)}")
+        hist = f"{_PREFIX}request_latency_ms"
+        lines.append(f"# TYPE {hist} histogram")
+        running = 0
+        for le, c in zip(LATENCY_BUCKETS_MS, counts):
+            running += c
+            lines.append(f'{hist}_bucket{{le="{_fmt(le)}"}} {running}')
+        lines.append(f'{hist}_bucket{{le="+Inf"}} {total}')
+        lines.append(f"{hist}_sum {_fmt(lat_sum)}")
+        lines.append(f"{hist}_count {total}")
+        # Convenience gauges (non-canonical but handy without a scraper).
+        for q, name in ((0.5, "p50"), (0.99, "p99")):
+            v = self.latency_quantile_ms(q)
+            if v is not None:
+                lines.append(f"# TYPE {_PREFIX}request_latency_{name}_ms gauge")
+                lines.append(f"{_PREFIX}request_latency_{name}_ms {_fmt(v)}")
+        return "\n".join(lines) + "\n"
+
+
+def _fmt(v: float) -> str:
+    """Integral values without the trailing .0 (Prometheus accepts both;
+    integers read better for counters)."""
+    f = float(v)
+    return str(int(f)) if f.is_integer() else repr(f)

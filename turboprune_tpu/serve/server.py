@@ -1,0 +1,235 @@
+"""Stdlib HTTP front-end for the inference engine.
+
+Endpoints:
+  POST /predict   {"instances": [[H][W][C] floats, ...]} (one image or a
+                  [n, H, W, C] nested list) -> {"logits": ..., "classes": ...}
+  GET  /healthz   engine/checkpoint info + queue depth (200 = ready)
+  GET  /metrics   Prometheus text exposition (serve/metrics.py)
+
+ThreadingHTTPServer gives one thread per connection; all of them funnel
+into the shared DynamicBatcher, which is where concurrency turns into
+batched device steps. Backpressure surfaces as HTTP 503 (bounded queue
+full) so load sheds at the edge instead of growing an unbounded backlog.
+No extra dependencies — stdlib http.server + json only.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from concurrent.futures import TimeoutError as FutureTimeoutError
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from .batcher import DynamicBatcher, QueueFullError
+from .engine import InferenceEngine
+from .metrics import ServeMetrics
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "turboprune-serve"
+
+    # server is the InferenceServer below.
+    def log_message(self, format, *args):  # noqa: A002 - stdlib signature
+        pass  # access logs off; metrics carry the signal
+
+    def _send_json(self, code: int, obj: dict, headers: dict = ()) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in dict(headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_text(self, code: int, text: str, ctype: str) -> None:
+        body = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 - stdlib casing
+        if self.path == "/healthz":
+            self._send_json(200, self.server.health())
+        elif self.path == "/metrics":
+            self._send_text(
+                200,
+                self.server.metrics.render_prometheus(),
+                "text/plain; version=0.0.4",
+            )
+        else:
+            self._send_json(404, {"error": f"unknown path {self.path!r}"})
+
+    def do_POST(self):  # noqa: N802 - stdlib casing
+        if self.path != "/predict":
+            self._send_json(404, {"error": f"unknown path {self.path!r}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            body = json.loads(self.rfile.read(length) or b"{}")
+            instances = body["instances"]
+        except (ValueError, KeyError) as e:
+            self._send_json(
+                400, {"error": f"expected JSON body with 'instances': {e!r}"}
+            )
+            return
+        engine = self.server.engine
+        try:
+            arr = np.asarray(instances, dtype=np.float32)
+        except (ValueError, TypeError) as e:
+            self._send_json(400, {"error": f"non-numeric instances: {e!r}"})
+            return
+        if arr.ndim == len(engine.input_shape):
+            arr = arr[None]
+        if (
+            arr.ndim != len(engine.input_shape) + 1
+            or arr.shape[1:] != engine.input_shape
+            or arr.shape[0] == 0
+        ):
+            self._send_json(
+                400,
+                {
+                    "error": (
+                        f"instances must be [n, "
+                        f"{', '.join(map(str, engine.input_shape))}] with "
+                        f"n >= 1, got shape {list(arr.shape)}"
+                    )
+                },
+            )
+            return
+        try:
+            future = self.server.batcher.submit(arr)
+        except QueueFullError as e:
+            self._send_json(
+                503, {"error": str(e)}, headers={"Retry-After": "1"}
+            )
+            return
+        try:
+            logits = future.result(timeout=self.server.request_timeout_s)
+        except FutureTimeoutError:
+            self._send_json(
+                504,
+                {"error": f"inference timed out after "
+                          f"{self.server.request_timeout_s}s"},
+            )
+            return
+        except Exception as e:  # engine/batcher failure — keep serving
+            self._send_json(500, {"error": repr(e)[:400]})
+            return
+        self._send_json(
+            200,
+            {
+                "logits": logits.tolist(),
+                "classes": np.argmax(logits, axis=-1).tolist(),
+                "model_level": engine.level,
+                "density": round(float(engine.density), 6),
+            },
+        )
+
+
+class InferenceServer(ThreadingHTTPServer):
+    """HTTP server owning the engine + batcher + metrics triple."""
+
+    daemon_threads = True
+
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 8000,
+        max_batch: int = 128,
+        max_wait_ms: float = 5.0,
+        queue_depth: int = 256,
+        request_timeout_s: float = 30.0,
+        metrics: Optional[ServeMetrics] = None,
+    ):
+        self.engine = engine
+        self.metrics = metrics or engine.metrics or ServeMetrics()
+        self.request_timeout_s = float(request_timeout_s)
+        self.batcher = DynamicBatcher(
+            engine,
+            max_batch=max_batch,
+            max_wait_ms=max_wait_ms,
+            queue_depth=queue_depth,
+            metrics=self.metrics,
+        ).start()
+        self._thread: Optional[threading.Thread] = None
+        super().__init__((host, port), _Handler)
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+    def health(self) -> dict:
+        return {
+            "status": "ok",
+            "queue_depth": self.batcher.queue_depth,
+            **self.engine.info(),
+        }
+
+    def start_background(self) -> "InferenceServer":
+        """serve_forever on a daemon thread (tests / embedding)."""
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self.serve_forever, name="turboprune-http", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        # shutdown() blocks on serve_forever's exit handshake — only safe
+        # when OUR background thread is running it. A foreground
+        # serve_forever (run_server.py) has already exited by the time
+        # close() runs; a never-started server must skip it entirely.
+        if self._thread is not None:
+            self.shutdown()
+            self._thread.join(5.0)
+        self.batcher.close()
+        self.server_close()
+
+
+def build_server(
+    cfg, expt_dir: str = "", metrics: Optional[ServeMetrics] = None
+) -> InferenceServer:
+    """Compose an InferenceServer from a MainConfig with the serve group
+    (conf/serve.yaml: ``defaults: [serve: default]``)."""
+    from ..config.schema import ConfigError
+
+    sc = cfg.serve
+    if sc is None:
+        raise ConfigError(
+            "config has no serve group — compose with conf/serve.yaml or "
+            "add '+serve=default'"
+        )
+    target = expt_dir or sc.expt_dir
+    if not target:
+        raise ConfigError(
+            "no experiment dir: pass --expt-dir or set serve.expt_dir"
+        )
+    metrics = metrics or ServeMetrics()
+    engine = InferenceEngine.from_experiment(
+        target,
+        level=sc.checkpoint_level,
+        role=sc.checkpoint_role,
+        buckets=tuple(sc.batch_buckets),
+        metrics=metrics,
+    )
+    if sc.warmup:
+        engine.warmup()
+    return InferenceServer(
+        engine,
+        host=sc.host,
+        port=sc.port,
+        max_batch=sc.max_batch,
+        max_wait_ms=sc.max_wait_ms,
+        queue_depth=sc.queue_depth,
+        request_timeout_s=sc.request_timeout_s,
+        metrics=metrics,
+    )

@@ -14,6 +14,7 @@ from .checkpoint import (
 )
 from .experiment import (
     MetricsLogger,
+    config_fingerprint,
     display_training_info,
     expt_prefix,
     gen_expt_dir,
@@ -33,6 +34,7 @@ __all__ = [
     "OPTIMIZER_INIT",
     "OPTIMIZER_REWIND",
     "MetricsLogger",
+    "config_fingerprint",
     "gen_expt_dir",
     "resume_experiment",
     "expt_prefix",

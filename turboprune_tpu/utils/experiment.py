@@ -91,6 +91,27 @@ def set_seed(seed: int, deterministic: bool = False) -> None:
     del deterministic  # XLA is deterministic-by-default for our op set
 
 
+def config_fingerprint(cfg: MainConfig) -> str:
+    """Short content hash of the TRAINING-RELEVANT config, used to stamp the
+    mid-level checkpoint slot: a resume whose config diverged (lr, epoch
+    budget, loader type, ...) must not silently restore mid-trajectory state
+    trained under the old config.
+
+    Excluded from the hash: the resume knobs themselves (a resumed run
+    flips ``resume_experiment`` and MUST still match its own slot) and the
+    serve group (serving knobs don't touch training)."""
+    import hashlib
+    import json
+
+    d = config_to_dict(cfg)
+    ep = d.get("experiment_params") or {}
+    ep.pop("resume_experiment", None)
+    ep.pop("resume_experiment_stuff", None)
+    d.pop("serve", None)
+    blob = json.dumps(d, sort_keys=True, default=str).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
 def save_config(expt_dir: str, cfg: MainConfig) -> Path:
     """Snapshot the composed config (reference save_config,
     harness_utils.py:148-156)."""
