@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Pre-PR gate: graftlint over the package + tests, then the tier-1 fast
+# test suite (the same command ROADMAP.md pins). Exits nonzero if either
+# fails. Run from anywhere: paths resolve relative to the repo root.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== graftlint (turboprune_tpu + tests) =="
+python -m turboprune_tpu.analysis turboprune_tpu tests
+
+echo "== tier-1 tests (fast tier, CPU) =="
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider
+
+echo "check.sh: all gates passed"

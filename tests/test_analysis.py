@@ -1,0 +1,580 @@
+"""graftlint (turboprune_tpu.analysis) tests.
+
+Three layers, mirroring the subsystem's contract:
+
+1. Per-rule fixtures: every rule has a BAD snippet it must catch and a
+   GOOD twin it must stay silent on — the rule set's behavior is pinned
+   code-first, so a rule change that widens/narrows matching fails here
+   before it floods (or silently stops protecting) the repo.
+2. Engine mechanics: waiver parsing/scoping/reasons, test-file rule
+   relaxations, reporter shapes, CLI exit codes.
+3. The SELF-GATE: the analyzer runs over the whole package + tests and
+   asserts zero unwaived findings and zero stale waivers. This is the test
+   that makes the rule set self-enforcing: any future PR that introduces a
+   host sync in a jitted body, reuses a key, or swallows an exception
+   fails tier-1 until the code is fixed or the site carries a reasoned
+   inline waiver.
+"""
+
+from __future__ import annotations
+
+import json
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from turboprune_tpu.analysis import (
+    RULES,
+    analyze_paths,
+    analyze_source,
+    render_json,
+    render_text,
+)
+from turboprune_tpu.analysis.cli import main as cli_main
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def run(src: str, path="lib/snippet.py", select=None):
+    """Unwaived findings for a dedented source snippet."""
+    findings, _ = analyze_source(textwrap.dedent(src), path, select=select)
+    return [f for f in findings if not f.waived]
+
+
+def rules_hit(src: str, **kw):
+    return {f.rule for f in run(src, **kw)}
+
+
+# --------------------------------------------------------------- fixtures
+# rule id -> (bad snippet that MUST trigger it, good twin that MUST NOT)
+FIXTURES = {
+    "jit-host-sync": (
+        """
+        import jax
+
+        @jax.jit
+        def step(state, batch):
+            loss = (state - batch).sum()
+            return loss.item()
+        """,
+        """
+        import jax
+
+        @jax.jit
+        def step(state, batch):
+            return (state - batch).sum()
+
+        def epoch(state, batch):
+            loss = step(state, batch)
+            return loss.item()
+        """,
+    ),
+    "retrace-hazard": (
+        """
+        import jax
+
+        def train(steps, x):
+            for _ in range(steps):
+                x = jax.jit(lambda a: a + 1)(x)
+            return x
+        """,
+        """
+        import jax
+
+        def _inc(a):
+            return a + 1
+
+        _inc_jit = jax.jit(_inc)
+
+        def train(steps, x):
+            for _ in range(steps):
+                x = _inc_jit(x)
+            return x
+        """,
+    ),
+    "static-argnames-mismatch": (
+        """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("sizes",))
+        def pad(x, size):
+            return x[:size]
+        """,
+        """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("size",))
+        def pad(x, size):
+            return x[:size]
+        """,
+    ),
+    "rng-key-reuse": (
+        """
+        import jax
+
+        def sample(key):
+            a = jax.random.normal(key, (2,))
+            b = jax.random.uniform(key, (2,))
+            return a + b
+        """,
+        """
+        import jax
+
+        def sample(key):
+            k1, k2 = jax.random.split(key)
+            a = jax.random.normal(k1, (2,))
+            b = jax.random.uniform(k2, (2,))
+            return a + b
+        """,
+    ),
+    "collective-order": (
+        """
+        import jax
+
+        def epoch_sum(x):
+            if jax.process_index() == 0:
+                total = jax.lax.psum(x, "data")
+                return total
+            return x
+        """,
+        """
+        import jax
+
+        def epoch_sum(x):
+            total = jax.lax.psum(x, "data")
+            if jax.process_index() == 0:
+                print("sum ready")
+            return total
+        """,
+    ),
+    "donated-arg-reuse": (
+        """
+        import jax
+
+        def run(step_fn, state, batch):
+            step = jax.jit(step_fn, donate_argnums=(0,))
+            new_state, metrics = step(state, batch)
+            drift = state.mean()
+            return new_state, metrics, drift
+        """,
+        """
+        import jax
+
+        def run(step_fn, state, batch):
+            step = jax.jit(step_fn, donate_argnums=(0,))
+            state, metrics = step(state, batch)
+            drift = state.mean()
+            return state, metrics, drift
+        """,
+    ),
+    "broad-except": (
+        """
+        def load(path):
+            try:
+                return open(path).read()
+            except Exception:
+                return None
+        """,
+        """
+        def load(path):
+            try:
+                return open(path).read()
+            except OSError as e:
+                print(f"unreadable {path}: {e}")
+                return None
+        """,
+    ),
+    "debug-in-hot-path": (
+        """
+        import jax
+
+        @jax.jit
+        def step(x):
+            jax.debug.print("x = {}", x)
+            return x * 2
+        """,
+        """
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x * 2
+
+        def debug_step(x):
+            y = step(x)
+            print("y =", y)
+            return y
+        """,
+    ),
+}
+
+
+class TestRuleFixtures:
+    def test_rule_count_meets_floor(self):
+        assert len(RULES) >= 8
+        assert set(FIXTURES) <= set(RULES)
+
+    @pytest.mark.parametrize("rule_id", sorted(FIXTURES))
+    def test_bad_snippet_caught(self, rule_id):
+        bad, _ = FIXTURES[rule_id]
+        hits = [f for f in run(bad) if f.rule == rule_id]
+        assert hits, f"{rule_id} missed its bad fixture"
+        # every finding carries a usable location + message
+        for f in hits:
+            assert f.line >= 1 and f.message and f.severity in (
+                "error",
+                "warning",
+            )
+
+    @pytest.mark.parametrize("rule_id", sorted(FIXTURES))
+    def test_good_twin_silent(self, rule_id):
+        _, good = FIXTURES[rule_id]
+        hits = [f for f in run(good) if f.rule == rule_id]
+        assert not hits, (
+            f"{rule_id} false-positived on its good twin: "
+            f"{[f.message for f in hits]}"
+        )
+
+
+class TestRuleEdgeCases:
+    def test_host_sync_float_of_traced_param(self):
+        src = """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x) * 2
+        """
+        assert "jit-host-sync" in rules_hit(src)
+
+    def test_host_sync_float_of_static_is_fine(self):
+        src = """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("n",))
+        def f(x, n):
+            return x / float(n) + x.shape[0]
+        """
+        assert "jit-host-sync" not in rules_hit(src)
+
+    def test_host_sync_inside_scan_body(self):
+        src = """
+        import jax
+        import numpy as np
+
+        def epoch(state, batches):
+            def body(s, b):
+                return s, np.asarray(b)
+            return jax.lax.scan(body, state, batches)
+        """
+        assert "jit-host-sync" in rules_hit(src)
+
+    def test_shard_map_body_via_partial(self):
+        src = """
+        import jax
+        from functools import partial
+        from jax.experimental.shard_map import shard_map
+
+        def kernel(x, axis_name):
+            return jax.device_get(x)
+
+        def run(mesh, x):
+            fn = shard_map(
+                partial(kernel, axis_name="data"),
+                mesh=mesh, in_specs=None, out_specs=None,
+            )
+            return fn(x)
+        """
+        assert "jit-host-sync" in rules_hit(src)
+
+    def test_retrace_jit_lower_in_function(self):
+        src = """
+        import jax
+
+        def compile_bucket(fn, spec):
+            return jax.jit(fn).lower(spec).compile()
+        """
+        assert "retrace-hazard" in rules_hit(src)
+
+    def test_retrace_factory_return_is_fine(self):
+        src = """
+        import jax
+
+        def make_step(fn, mesh):
+            return jax.jit(fn, donate_argnums=(0,))
+        """
+        assert "retrace-hazard" not in rules_hit(src)
+
+    def test_rng_fold_in_loop_is_fine(self):
+        src = """
+        import jax
+
+        def draws(key, n):
+            out = []
+            for i in range(n):
+                k = jax.random.fold_in(key, i)
+                out.append(jax.random.normal(k, ()))
+            return out
+        """
+        assert "rng-key-reuse" not in rules_hit(src)
+
+    def test_rng_cross_iteration_reuse_caught(self):
+        src = """
+        import jax
+
+        def draws(key, n):
+            out = []
+            for i in range(n):
+                out.append(jax.random.normal(key, ()))
+            return out
+        """
+        assert "rng-key-reuse" in rules_hit(src)
+
+    def test_rng_early_return_dispatch_is_fine(self):
+        src = """
+        import jax
+
+        def prune(method, masks, rng):
+            if method == "a":
+                return jax.random.bernoulli(rng, 0.5)
+            if method == "b":
+                return jax.random.normal(rng, (2,))
+            return masks
+        """
+        assert "rng-key-reuse" not in rules_hit(src)
+
+    def test_rng_numpy_generator_named_rng_is_fine(self):
+        src = """
+        import numpy as np
+
+        def crop(img, rng):
+            x = int(rng.integers(0, 4))
+            y = int(rng.integers(0, 4))
+            return img[y:, x:]
+        """
+        assert "rng-key-reuse" not in rules_hit(src)
+
+    def test_rng_constant_key_in_library(self):
+        src = "import jax\nKEY = jax.random.PRNGKey(0)\n"
+        findings, _ = analyze_source(src, "lib/mod.py")
+        assert any(f.rule == "rng-key-reuse" for f in findings)
+
+    def test_rng_constant_key_in_tests_exempt(self):
+        src = "import jax\nKEY = jax.random.PRNGKey(0)\n"
+        findings, _ = analyze_source(src, "tests/test_mod.py")
+        assert not any(f.rule == "rng-key-reuse" for f in findings)
+
+    def test_collective_under_is_primary_wrapper(self):
+        src = """
+        from turboprune_tpu.parallel.multihost import broadcast_object, is_primary
+
+        def share(obj):
+            if is_primary():
+                return broadcast_object(obj)
+            return None
+        """
+        assert "collective-order" in rules_hit(src)
+
+    def test_collective_process_count_guard_is_fine(self):
+        src = """
+        import jax
+        from jax.experimental import multihost_utils
+
+        def barrier():
+            if jax.process_count() > 1:
+                multihost_utils.sync_global_devices("b")
+        """
+        assert "collective-order" not in rules_hit(src)
+
+    def test_donated_inline_jit_call(self):
+        src = """
+        import jax
+
+        def run(fn, x):
+            y = jax.jit(fn, donate_argnums=(0,))(x)
+            return y + x
+        """
+        assert "donated-arg-reuse" in rules_hit(src)
+
+    def test_donated_loop_rebind_is_fine(self):
+        src = """
+        import jax
+
+        def run(fn, state, batches):
+            step = jax.jit(fn, donate_argnums=(0,))
+            for b in batches:
+                state, m = step(state, b)
+            return state
+        """
+        assert "donated-arg-reuse" not in rules_hit(src)
+
+    def test_broad_except_with_reraise_is_fine(self):
+        src = """
+        def f():
+            try:
+                g()
+            except Exception:
+                cleanup()
+                raise
+        """
+        assert "broad-except" not in rules_hit(src)
+
+    def test_parse_error_is_a_finding(self):
+        findings, _ = analyze_source("def broken(:\n", "lib/bad.py")
+        assert [f.rule for f in findings] == ["parse-error"]
+
+
+class TestWaivers:
+    BAD = "def f():\n    try:\n        g()\n    except Exception:\n        return None\n"
+
+    def test_inline_waiver_suppresses_with_reason(self):
+        src = self.BAD.replace(
+            "except Exception:",
+            "except Exception:  # graftlint: disable=broad-except -- deliberate fallback",
+        )
+        findings, waivers = analyze_source(src, "lib/m.py")
+        assert not [f for f in findings if not f.waived]
+        (w,) = [f for f in findings if f.waived]
+        assert w.waiver_reason == "deliberate fallback"
+        assert all(wv.used for wv in waivers)
+
+    def test_standalone_waiver_covers_next_line(self):
+        src = self.BAD.replace(
+            "    except Exception:",
+            "    # graftlint: disable=broad-except -- next-line scope\n"
+            "    except Exception:",
+        )
+        findings, _ = analyze_source(src, "lib/m.py")
+        assert not [f for f in findings if not f.waived]
+
+    def test_waiver_for_other_rule_does_not_suppress(self):
+        src = self.BAD.replace(
+            "except Exception:",
+            "except Exception:  # graftlint: disable=jit-host-sync -- wrong rule",
+        )
+        findings, waivers = analyze_source(src, "lib/m.py")
+        assert [f for f in findings if not f.waived]
+        assert not any(w.used for w in waivers)
+
+    def test_multi_rule_waiver(self):
+        src = self.BAD.replace(
+            "except Exception:",
+            "except Exception:  # graftlint: disable=jit-host-sync,broad-except -- both",
+        )
+        findings, _ = analyze_source(src, "lib/m.py")
+        assert not [f for f in findings if not f.waived]
+
+    def test_reasonless_waiver_still_parses(self):
+        src = self.BAD.replace(
+            "except Exception:",
+            "except Exception:  # graftlint: disable=broad-except",
+        )
+        findings, _ = analyze_source(src, "lib/m.py")
+        (w,) = [f for f in findings if f.waived]
+        assert w.waiver_reason is None
+
+    def test_waiver_inside_string_literal_ignored(self):
+        src = (
+            's = "graftlint: disable=broad-except -- not a comment"\n'
+            + self.BAD
+        )
+        findings, waivers = analyze_source(src, "lib/m.py")
+        assert [f for f in findings if not f.waived]
+        assert not waivers
+
+
+class TestReportersAndCli:
+    def _write(self, tmp_path, name, src):
+        p = tmp_path / name
+        p.write_text(textwrap.dedent(src))
+        return p
+
+    def test_json_reporter_shape(self, tmp_path):
+        bad = self._write(tmp_path, "bad.py", FIXTURES["broad-except"][0])
+        payload = json.loads(render_json(analyze_paths([bad])))
+        assert payload["version"] == 1
+        assert payload["files_analyzed"] == 1
+        assert payload["summary"]["unwaived"] >= 1
+        assert payload["summary"]["by_rule"].get("broad-except", 0) >= 1
+        (f,) = [
+            f
+            for f in payload["findings"]
+            if f["rule"] == "broad-except" and not f["waived"]
+        ]
+        assert set(f) == {
+            "file",
+            "line",
+            "col",
+            "rule",
+            "severity",
+            "message",
+            "waived",
+            "waiver_reason",
+        }
+        assert payload["unused_waivers"] == []
+
+    def test_text_reporter_grepable(self, tmp_path):
+        bad = self._write(tmp_path, "bad.py", FIXTURES["broad-except"][0])
+        text = render_text(analyze_paths([bad]))
+        assert f"{bad}:" in text and "broad-except" in text
+        assert "graftlint: 1 finding(s)" in text
+
+    def test_cli_exit_codes(self, tmp_path, capsys):
+        bad = self._write(tmp_path, "bad.py", FIXTURES["broad-except"][0])
+        good = self._write(tmp_path, "good.py", FIXTURES["broad-except"][1])
+        assert cli_main([str(bad)]) == 1
+        assert "broad-except" in capsys.readouterr().out
+        assert cli_main([str(good)]) == 0
+        assert cli_main(["--list-rules"]) == 0
+        assert "jit-host-sync" in capsys.readouterr().out
+        assert cli_main(["--select", "no-such-rule", str(good)]) == 2
+        assert cli_main([str(tmp_path / "missing.py")]) == 2
+
+    def test_cli_select_narrows(self, tmp_path, capsys):
+        bad = self._write(tmp_path, "bad.py", FIXTURES["broad-except"][0])
+        assert cli_main(["--select", "jit-host-sync", str(bad)]) == 0
+        capsys.readouterr()
+
+
+class TestSelfGate:
+    """The rule set enforces itself on every future PR."""
+
+    def test_package_and_tests_have_zero_unwaived_findings(self):
+        result = analyze_paths(
+            [REPO / "turboprune_tpu", REPO / "tests"]
+        )
+        msg = "\n".join(
+            f"  {f.file}:{f.line}: [{f.rule}] {f.message}"
+            for f in result.unwaived
+        )
+        assert not result.unwaived, (
+            "graftlint found unwaived findings — fix them or add an "
+            "inline '# graftlint: disable=<rule> -- reason' waiver:\n"
+            + msg
+        )
+
+    def test_no_stale_waivers(self):
+        result = analyze_paths(
+            [REPO / "turboprune_tpu", REPO / "tests"]
+        )
+        stale = "\n".join(
+            f"  {w.file}:{w.line}: {sorted(w.rules)}"
+            for w in result.unused_waivers
+        )
+        assert not result.unused_waivers, (
+            "waivers matching no finding (remove them, they mask "
+            "nothing):\n" + stale
+        )
+
+    def test_every_package_waiver_has_a_reason(self):
+        result = analyze_paths([REPO / "turboprune_tpu"])
+        missing = [
+            f"{w.file}:{w.line}" for w in result.waivers if not w.reason
+        ]
+        assert not missing, (
+            "package waivers must document WHY: " + ", ".join(missing)
+        )

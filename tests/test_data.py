@@ -70,6 +70,7 @@ class TestAugment:
         even = augment_epoch(
             x, k, jnp.asarray(0), crop_size=8, flip=True, translate=0, altflip=True
         )
+        # graftlint: disable=rng-key-reuse -- deliberate: same key on both calls proves the odd-epoch output is exactly the flipped even-epoch output
         odd = augment_epoch(
             x, k, jnp.asarray(1), crop_size=8, flip=True, translate=0, altflip=True
         )

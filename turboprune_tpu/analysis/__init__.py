@@ -1,0 +1,45 @@
+"""graftlint — JAX-aware static analysis for this repo.
+
+``python -m turboprune_tpu.analysis [paths]`` runs eight rules tuned to
+the failure modes that sink JAX/TPU training and serving stacks: host
+syncs inside jit, trace-cache-defeating jit construction, static_argnames
+typos, PRNG key reuse, rank-conditional collectives, donated-buffer
+reads, silent broad excepts, and debug output in compiled code. Findings
+are waived inline with ``# graftlint: disable=<rule> -- reason`` and the
+whole package is kept at zero unwaived findings by
+tests/test_analysis.py's self-gate.
+
+Deliberately jax-free: importing this package must work on any machine
+(pre-commit, CI sandboxes) without an accelerator stack. Importing
+``rules`` registers the rule set as a side effect.
+"""
+
+from .core import (  # noqa: F401
+    AnalysisResult,
+    Finding,
+    ModuleContext,
+    RULES,
+    Rule,
+    Waiver,
+    analyze_paths,
+    analyze_source,
+    is_test_file,
+    register,
+)
+from . import rules  # noqa: F401  (registers the rule set)
+from .reporters import render_json, render_text  # noqa: F401
+
+__all__ = [
+    "AnalysisResult",
+    "Finding",
+    "ModuleContext",
+    "RULES",
+    "Rule",
+    "Waiver",
+    "analyze_paths",
+    "analyze_source",
+    "is_test_file",
+    "register",
+    "render_json",
+    "render_text",
+]

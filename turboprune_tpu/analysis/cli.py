@@ -1,0 +1,94 @@
+"""graftlint CLI: ``python -m turboprune_tpu.analysis [paths...]``.
+
+Exit codes (the contract scripts/check.sh and CI build on):
+  0 — analyzed clean: zero unwaived findings
+  1 — at least one unwaived finding
+  2 — usage / environment error (bad path, unknown rule in --select)
+
+With no paths it analyzes the installed ``turboprune_tpu`` package — the
+same invocation the self-gate test makes, so "the linter passes" means the
+same thing locally, in CI, and in tests/test_analysis.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+from typing import Optional, Sequence
+
+from .core import RULES, analyze_paths
+from .reporters import render_json, render_text
+
+
+def _default_paths() -> list:
+    return [str(Path(__file__).resolve().parents[1])]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m turboprune_tpu.analysis",
+        description=(
+            "graftlint: JAX-aware static analysis (host syncs in jit, "
+            "retrace hazards, PRNG key reuse, rank-conditional "
+            "collectives, donated-buffer reads, swallowed exceptions)"
+        ),
+    )
+    p.add_argument(
+        "paths",
+        nargs="*",
+        help="files or directories (default: the turboprune_tpu package)",
+    )
+    p.add_argument(
+        "--json", action="store_true", help="machine-readable JSON report"
+    )
+    p.add_argument(
+        "--show-waived",
+        action="store_true",
+        help="include waived findings in the text report",
+    )
+    p.add_argument(
+        "--select",
+        metavar="RULES",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    p.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="print the rule catalog and exit",
+    )
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        width = max(len(r) for r in RULES)
+        for rule in RULES.values():
+            print(f"{rule.id:<{width}}  [{rule.severity}] {rule.description}")
+        return 0
+
+    select = None
+    if args.select:
+        select = [r.strip() for r in args.select.split(",") if r.strip()]
+        unknown = [r for r in select if r not in RULES]
+        if unknown:
+            print(
+                f"unknown rule(s): {', '.join(unknown)} "
+                f"(known: {', '.join(sorted(RULES))})",
+                file=sys.stderr,
+            )
+            return 2
+
+    try:
+        result = analyze_paths(args.paths or _default_paths(), select=select)
+    except (FileNotFoundError, OSError) as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(render_json(result))
+    else:
+        print(render_text(result, show_waived=args.show_waived))
+    return 1 if result.unwaived else 0

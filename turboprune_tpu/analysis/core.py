@@ -1,0 +1,334 @@
+"""graftlint core: findings, waivers, the rule registry, and the driver.
+
+A JAX codebase fails in ways generic linters never see: a stray ``.item()``
+inside a jitted body silently serializes the TPU pipeline, a ``jax.jit`` in
+a loop recompiles every iteration, a reused PRNG key correlates "random"
+draws, and a collective under a ``process_index()`` branch deadlocks the
+pod. Each of those classes has already cost this repo debugging time (see
+ISSUE history: the silent no-op config in the cyclic harness, the
+permutation-invariant equality check) — so the rules live here, run on
+every PR, and gate via tests/test_analysis.py's self-gate instead of
+relying on a reviewer to re-find them.
+
+Design: pure stdlib ``ast`` — importing this package must never import jax
+(the analyzer has to run in any environment, including pre-commit hooks on
+machines with no accelerator stack). Rules are small ``ast`` visitors
+registered in ``RULES``; the driver parses each file once, hands every rule
+a shared :class:`ModuleContext` (source, tree, lazily-built jit-region
+index), and applies inline waivers afterwards so waived findings still
+appear in reports (auditable, not invisible).
+
+Waiver syntax, checked by tests/test_analysis.py::test_waiver_*::
+
+    x = bad_thing()  # graftlint: disable=rule-id[,other-rule] -- reason
+
+A waiver comment alone on a line applies to the next code line (for sites
+where the waived statement is long). The reason text is optional to the
+parser but required by convention: it doubles as documentation of WHY the
+site is exempt, and reviewers should reject reason-less waivers.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import re
+import tokenize
+from pathlib import Path
+from typing import Iterable, Iterator, Optional, Sequence
+
+__all__ = [
+    "Finding",
+    "Waiver",
+    "Rule",
+    "RULES",
+    "register",
+    "ModuleContext",
+    "AnalysisResult",
+    "analyze_source",
+    "analyze_paths",
+    "is_test_file",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location."""
+
+    file: str
+    line: int
+    col: int
+    rule: str
+    severity: str  # "error" | "warning"
+    message: str
+    waived: bool = False
+    waiver_reason: Optional[str] = None
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class Waiver:
+    """A parsed ``# graftlint: disable=...`` comment."""
+
+    file: str
+    line: int  # line the comment sits on
+    rules: frozenset
+    reason: Optional[str]
+    applies_to: int  # line whose findings it waives
+    used: bool = False
+
+    def as_dict(self) -> dict:
+        return {
+            "file": self.file,
+            "line": self.line,
+            "rules": sorted(self.rules),
+            "reason": self.reason,
+            "applies_to": self.applies_to,
+            "used": self.used,
+        }
+
+
+_WAIVER_RE = re.compile(
+    r"graftlint:\s*disable=([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)"
+    r"(?:\s*--\s*(.*\S))?\s*$"
+)
+
+
+def parse_waivers(source: str, file: str) -> list:
+    """Extract waivers via the tokenizer (a ``#`` inside a string literal is
+    not a comment). A comment-only line waives the NEXT code line."""
+    comments: list[tuple[int, str, bool]] = []  # (line, text, standalone)
+    code_lines: set[int] = set()
+    try:
+        tokens = list(
+            tokenize.generate_tokens(io.StringIO(source).readline)
+        )
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return []
+    for tok in tokens:
+        if tok.type == tokenize.COMMENT:
+            standalone = tok.line.strip().startswith("#")
+            comments.append((tok.start[0], tok.string, standalone))
+        elif tok.type not in (
+            tokenize.NL,
+            tokenize.NEWLINE,
+            tokenize.INDENT,
+            tokenize.DEDENT,
+            tokenize.ENDMARKER,
+        ):
+            for ln in range(tok.start[0], tok.end[0] + 1):
+                code_lines.add(ln)
+
+    waivers = []
+    for line, text, standalone in comments:
+        m = _WAIVER_RE.search(text)
+        if not m:
+            continue
+        rules = frozenset(r.strip() for r in m.group(1).split(","))
+        if standalone:
+            later = [ln for ln in code_lines if ln > line]
+            applies_to = min(later) if later else line
+        else:
+            applies_to = line
+        waivers.append(
+            Waiver(
+                file=file,
+                line=line,
+                rules=rules,
+                reason=m.group(2),
+                applies_to=applies_to,
+            )
+        )
+    return waivers
+
+
+def is_test_file(path) -> bool:
+    """Test files get a few deliberately looser rules (``skip_in_tests``):
+    tests construct throwaway jits and fixed PRNG keys on purpose."""
+    p = Path(path)
+    if any(part == "tests" for part in p.parts):
+        return True
+    return p.name.startswith("test_") or p.name == "conftest.py"
+
+
+class ModuleContext:
+    """Everything a rule needs about one file, parsed once."""
+
+    def __init__(self, path, source: str):
+        self.path = str(path)
+        self.source = source
+        self.tree = ast.parse(source)  # caller handles SyntaxError
+        self.is_test = is_test_file(path)
+        self._regions = None
+
+    @property
+    def jit_regions(self):
+        """Lazily-built lexical jit/trace region index (regions.py)."""
+        if self._regions is None:
+            from .regions import build_jit_regions
+
+            self._regions = build_jit_regions(self.tree)
+        return self._regions
+
+    def finding(
+        self, rule: "Rule", node: ast.AST, message: str
+    ) -> Finding:
+        return Finding(
+            file=self.path,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0),
+            rule=rule.id,
+            severity=rule.severity,
+            message=message,
+        )
+
+
+class Rule:
+    """Base class: subclass, set ``id``/``severity``/``description``,
+    implement ``check``, and decorate with :func:`register`."""
+
+    id: str = ""
+    severity: str = "error"
+    description: str = ""
+    # True: rule does not run on tests/conftest files (see is_test_file).
+    skip_in_tests: bool = False
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+RULES: dict = {}
+
+
+def register(cls):
+    rule = cls()
+    assert rule.id and rule.id not in RULES, f"bad rule id {rule.id!r}"
+    RULES[rule.id] = rule
+    return cls
+
+
+@dataclasses.dataclass
+class AnalysisResult:
+    findings: list  # every Finding, waived ones flagged
+    waivers: list  # every Waiver, used ones flagged
+    files_analyzed: int
+
+    @property
+    def unwaived(self) -> list:
+        return [f for f in self.findings if not f.waived]
+
+    @property
+    def waived(self) -> list:
+        return [f for f in self.findings if f.waived]
+
+    @property
+    def unused_waivers(self) -> list:
+        return [w for w in self.waivers if not w.used]
+
+
+def _apply_waivers(
+    findings: list, waivers: list
+) -> list:
+    by_line: dict[int, list] = {}
+    for w in waivers:
+        by_line.setdefault(w.applies_to, []).append(w)
+    out = []
+    for f in findings:
+        hit = None
+        for w in by_line.get(f.line, ()):
+            if f.rule in w.rules:
+                hit = w
+                break
+        if hit is not None:
+            hit.used = True
+            out.append(
+                dataclasses.replace(f, waived=True, waiver_reason=hit.reason)
+            )
+        else:
+            out.append(f)
+    return out
+
+
+def analyze_source(
+    source: str,
+    path="<string>",
+    select: Optional[Sequence[str]] = None,
+) -> tuple:
+    """Run every (selected) rule over one module. Returns
+    ``(findings, waivers)`` with waivers already applied."""
+    file = str(path)
+    waivers = parse_waivers(source, file)
+    try:
+        ctx = ModuleContext(file, source)
+    except SyntaxError as e:
+        findings = [
+            Finding(
+                file=file,
+                line=e.lineno or 1,
+                col=(e.offset or 1) - 1,
+                rule="parse-error",
+                severity="error",
+                message=f"file does not parse: {e.msg}",
+            )
+        ]
+        return _apply_waivers(findings, waivers), waivers
+
+    findings = []
+    for rule in RULES.values():
+        if select and rule.id not in select:
+            continue
+        if rule.skip_in_tests and ctx.is_test:
+            continue
+        findings.extend(rule.check(ctx))
+    # Nested jit regions (a scan body inside a jitted def) can surface the
+    # same node twice — collapse exact duplicates.
+    seen: set = set()
+    unique = []
+    for f in sorted(findings, key=lambda f: (f.line, f.col, f.rule)):
+        key = (f.line, f.col, f.rule, f.message)
+        if key not in seen:
+            seen.add(key)
+            unique.append(f)
+    return _apply_waivers(unique, waivers), waivers
+
+
+def iter_python_files(paths: Iterable) -> list:
+    files: list[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            files.extend(
+                f
+                for f in sorted(p.rglob("*.py"))
+                if "__pycache__" not in f.parts
+            )
+        elif p.suffix == ".py":
+            files.append(p)
+        else:
+            raise FileNotFoundError(f"not a .py file or directory: {p}")
+    return files
+
+
+def analyze_paths(
+    paths: Iterable,
+    select: Optional[Sequence[str]] = None,
+) -> AnalysisResult:
+    """Analyze every ``.py`` under ``paths`` (files or directories)."""
+    all_findings: list = []
+    all_waivers: list = []
+    files = iter_python_files(paths)
+    for f in files:
+        findings, waivers = analyze_source(
+            f.read_text(encoding="utf-8"), f, select=select
+        )
+        all_findings.extend(findings)
+        all_waivers.extend(waivers)
+    all_findings.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
+    return AnalysisResult(
+        findings=all_findings,
+        waivers=all_waivers,
+        files_analyzed=len(files),
+    )

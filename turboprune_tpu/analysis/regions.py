@@ -1,0 +1,241 @@
+"""Lexical jit/trace region detection.
+
+"Inside jit" is a dynamic property, but in this codebase (and most JAX
+code) it is almost always visible lexically: a function is traced because
+it is decorated with ``jax.jit``/``@partial(jax.jit, ...)``, passed to a
+transform (``jax.jit(f)``, ``shard_map(f, ...)``), or used as the body of a
+control-flow primitive (``lax.scan``/``cond``/``while_loop``). This module
+finds those function bodies and records which parameters are traced
+(``static_argnames`` are Python values, so ``float(static_flag)`` is fine
+while ``float(traced_x)`` is a device sync).
+
+Known blind spot, by design: a plain function that is only jitted at a
+distant call site (e.g. train/steps.py step fns jitted inside
+parallel/mesh.py factories) is not marked — interprocedural analysis is
+out of scope. The rules built on this index therefore never claim
+completeness; they claim zero false negatives on the LEXICAL patterns,
+which is what the positive/negative fixture tests in
+tests/test_analysis.py pin down.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Optional
+
+__all__ = ["JitRegion", "build_jit_regions", "dotted_name", "is_jit_wrapper"]
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``jax.lax.scan`` -> "jax.lax.scan"; None for non-name expressions."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted_name(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+# Wrappers that COMPILE the function they receive.
+_JIT_TAILS = {"jit", "pjit"}
+# Transforms/primitives that TRACE a function argument. Bare names are
+# accepted only for the ones this repo imports unqualified; the generic
+# short words (scan, map, cond, ...) require a lax/jax prefix so we don't
+# flag builtins or unrelated helpers.
+_TRACE_BARE = {
+    "jit",
+    "pjit",
+    "shard_map",
+    "vmap",
+    "pmap",
+    "grad",
+    "value_and_grad",
+    "remat",
+}
+_TRACE_TRANSFORM_TAILS = {
+    "jit",
+    "pjit",
+    "shard_map",
+    "vmap",
+    "pmap",
+    "grad",
+    "value_and_grad",
+    "remat",
+    "checkpoint",
+}
+# Short generic words (scan, map, cond...) are tracing ONLY under lax —
+# jax.tree.map / builtins.map must not match.
+_TRACE_LAX_TAILS = {
+    "scan",
+    "cond",
+    "while_loop",
+    "fori_loop",
+    "map",
+    "switch",
+    "associative_scan",
+}
+_JAXY_ROOTS = {"jax", "lax", "nn"}
+
+
+def is_jit_wrapper(func: ast.AST) -> bool:
+    """True for ``jax.jit`` / ``jit`` / ``pjit`` style callables."""
+    name = dotted_name(func)
+    if not name:
+        return False
+    parts = name.split(".")
+    return parts[-1] in _JIT_TAILS and (
+        len(parts) == 1 or parts[0] in _JAXY_ROOTS
+    )
+
+
+def _is_tracing_call(func: ast.AST) -> bool:
+    name = dotted_name(func)
+    if not name:
+        return False
+    parts = name.split(".")
+    if len(parts) == 1:
+        return parts[0] in _TRACE_BARE
+    if parts[-1] in _TRACE_LAX_TAILS:
+        return parts[-2] == "lax"
+    return parts[-1] in _TRACE_TRANSFORM_TAILS and parts[0] in _JAXY_ROOTS
+
+
+def _is_partial(func: ast.AST) -> bool:
+    name = dotted_name(func)
+    return name in ("partial", "functools.partial")
+
+
+def literal_str_seq(node: ast.AST) -> Optional[list]:
+    """``"x"`` or ``("x", "y")``/``["x"]`` -> list of strings; else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out = []
+        for elt in node.elts:
+            if not (
+                isinstance(elt, ast.Constant) and isinstance(elt.value, str)
+            ):
+                return None
+            out.append(elt.value)
+        return out
+    return None
+
+
+def param_names(fn: ast.AST) -> list:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    return names
+
+
+@dataclasses.dataclass
+class JitRegion:
+    """One traced function body."""
+
+    node: ast.AST  # FunctionDef / AsyncFunctionDef / Lambda
+    start: int
+    end: int
+    reason: str  # human-readable: how this body ends up traced
+    traced_params: frozenset  # param names that are traced values
+
+    def walk(self):
+        return ast.walk(self.node)
+
+
+def _static_names_from_call(call: ast.Call) -> list:
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            return literal_str_seq(kw.value) or []
+    return []
+
+
+def _region_for_def(
+    fn: ast.AST, reason: str, static: list = ()
+) -> JitRegion:
+    traced = [p for p in param_names(fn) if p not in set(static)]
+    # `self` is never a traced array in this codebase's method style.
+    traced = [p for p in traced if p != "self"]
+    return JitRegion(
+        node=fn,
+        start=fn.lineno,
+        end=fn.end_lineno or fn.lineno,
+        reason=reason,
+        traced_params=frozenset(traced),
+    )
+
+
+def _unwrap_partial(node: ast.AST) -> ast.AST:
+    """partial(f, ...) -> f (one level is all the repo uses)."""
+    if (
+        isinstance(node, ast.Call)
+        and _is_partial(node.func)
+        and node.args
+    ):
+        return node.args[0]
+    return node
+
+
+def build_jit_regions(tree: ast.Module) -> list:
+    """All lexically-traced function bodies in a module."""
+    defs: dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = node
+
+    regions: dict[tuple, JitRegion] = {}
+
+    def add(region: JitRegion) -> None:
+        regions.setdefault((region.start, region.end), region)
+
+    def add_callable(node: ast.AST, reason: str, static: list) -> None:
+        node = _unwrap_partial(node)
+        if isinstance(node, ast.Lambda):
+            add(
+                JitRegion(
+                    node=node,
+                    start=node.lineno,
+                    end=node.end_lineno or node.lineno,
+                    reason=reason,
+                    traced_params=frozenset(
+                        p for p in param_names(node) if p not in set(static)
+                    ),
+                )
+            )
+        elif isinstance(node, ast.Name) and node.id in defs:
+            add(_region_for_def(defs[node.id], reason, static))
+
+    for node in ast.walk(tree):
+        # -- decorated defs: @jax.jit / @partial(jax.jit, static_argnames=..)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if is_jit_wrapper(dec):
+                    add(_region_for_def(node, f"@{dotted_name(dec)}"))
+                elif isinstance(dec, ast.Call):
+                    if is_jit_wrapper(dec.func):
+                        add(
+                            _region_for_def(
+                                node,
+                                f"@{dotted_name(dec.func)}(...)",
+                                _static_names_from_call(dec),
+                            )
+                        )
+                    elif _is_partial(dec.func) and dec.args and is_jit_wrapper(
+                        dec.args[0]
+                    ):
+                        add(
+                            _region_for_def(
+                                node,
+                                f"@partial({dotted_name(dec.args[0])}, ...)",
+                                _static_names_from_call(dec),
+                            )
+                        )
+        # -- function arguments to jit/shard_map/lax control flow
+        elif isinstance(node, ast.Call) and _is_tracing_call(node.func):
+            static = _static_names_from_call(node)
+            reason = f"passed to {dotted_name(node.func)}"
+            for arg in node.args:
+                add_callable(arg, reason, static)
+
+    return sorted(regions.values(), key=lambda r: (r.start, r.end))

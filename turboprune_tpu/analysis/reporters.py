@@ -1,0 +1,63 @@
+"""Finding reporters: human text and machine JSON.
+
+Text goes to reviewers and CI logs (one grep-able line per finding, the
+same ``path:line:col:`` shape compilers use, so editors jump to it). JSON
+is the stable machine surface — its shape is pinned by
+tests/test_analysis.py::test_json_reporter_shape, so downstream tooling
+(dashboards, the check.sh gate, future pre-commit hooks) can rely on it.
+Waived findings are REPORTED, not hidden: a waiver is an argued exception,
+and the reason string travels with the finding so audits don't need to
+open the source.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+
+from .core import AnalysisResult
+
+__all__ = ["render_text", "render_json"]
+
+JSON_SCHEMA_VERSION = 1
+
+
+def render_text(result: AnalysisResult, show_waived: bool = False) -> str:
+    lines = []
+    for f in result.findings:
+        if f.waived and not show_waived:
+            continue
+        tag = " (waived: %s)" % (f.waiver_reason or "no reason given") if f.waived else ""
+        lines.append(
+            f"{f.file}:{f.line}:{f.col + 1}: {f.rule} {f.severity}: "
+            f"{f.message}{tag}"
+        )
+    for w in result.unused_waivers:
+        lines.append(
+            f"{w.file}:{w.line}: note: waiver for "
+            f"{','.join(sorted(w.rules))} matched no finding — stale? "
+            "(does not gate)"
+        )
+    n_unwaived = len(result.unwaived)
+    n_waived = len(result.waived)
+    lines.append(
+        f"graftlint: {n_unwaived} finding(s) "
+        f"({n_waived} waived) in {result.files_analyzed} file(s)"
+    )
+    return "\n".join(lines)
+
+
+def render_json(result: AnalysisResult) -> str:
+    by_rule = Counter(f.rule for f in result.unwaived)
+    payload = {
+        "version": JSON_SCHEMA_VERSION,
+        "files_analyzed": result.files_analyzed,
+        "summary": {
+            "unwaived": len(result.unwaived),
+            "waived": len(result.waived),
+            "by_rule": dict(sorted(by_rule.items())),
+        },
+        "findings": [f.as_dict() for f in result.findings],
+        "unused_waivers": [w.as_dict() for w in result.unused_waivers],
+    }
+    return json.dumps(payload, indent=2, sort_keys=False)

@@ -1,0 +1,1014 @@
+"""The graftlint rule set: eight JAX failure classes, tuned to this repo.
+
+Every rule documents WHY its pattern matters on TPU, because the finding
+message is what a contributor sees at review time. Severities: "error" for
+patterns that corrupt results or deadlock (host syncs in compiled code,
+key reuse, rank-conditional collectives, donated-buffer reads), "warning"
+for patterns that burn performance or hide failures (retraces, swallowed
+exceptions, debug prints). The CLI gates on BOTH — a warning you disagree
+with gets an inline waiver with a reason, not silence.
+
+Each rule has a catching + non-catching fixture pair in
+tests/test_analysis.py; change a rule and its fixtures together.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from .core import ModuleContext, Rule, register
+from .regions import (
+    dotted_name,
+    is_jit_wrapper,
+    literal_str_seq,
+    param_names,
+)
+
+# ------------------------------------------------------------------ helpers
+
+# Attribute reads that are STATIC under tracing (shape metadata): names that
+# only appear under these are not device values.
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+
+def _tail(name: Optional[str]) -> str:
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+def _root(name: Optional[str]) -> str:
+    return name.split(".", 1)[0] if name else ""
+
+
+def _target_names(t: ast.AST) -> list:
+    """Top-level assignable dotted names of an assignment target —
+    ``self.state, m`` -> ["self.state", "m"] (NOT the nested "self")."""
+    if isinstance(t, (ast.Tuple, ast.List)):
+        out = []
+        for e in t.elts:
+            out.extend(_target_names(e))
+        return out
+    if isinstance(t, ast.Starred):
+        return _target_names(t.value)
+    name = dotted_name(t)
+    return [name] if name else []
+
+
+def _walk_prune_calls(node: ast.AST):
+    """Walk an expression WITHOUT descending into nested Call nodes —
+    names belong to the innermost call that receives them."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, ast.Call):
+                continue
+            stack.append(child)
+
+
+def _names_directly_under(call: ast.Call) -> list:
+    """Dotted names appearing as (sub)expressions of a call's arguments,
+    excluding anything inside a nested call (a nested call is charged
+    separately, when the walk reaches it)."""
+    out = []
+    for arg in list(call.args) + [k.value for k in call.keywords]:
+        if isinstance(arg, ast.Call):
+            continue
+        for n in _walk_prune_calls(arg):
+            name = dotted_name(n)
+            if name and isinstance(n, (ast.Name, ast.Attribute)):
+                out.append(name)
+    return out
+
+
+def _terminates(stmts) -> bool:
+    """True when control cannot fall off the end of this statement list."""
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue)
+    )
+
+
+def _traced_name_hits(expr: ast.AST, traced: frozenset) -> list:
+    """Names of traced params used as VALUES in ``expr`` — occurrences
+    under ``.shape``/``.ndim``/``.dtype``/``.size`` are static metadata
+    and don't count."""
+    shielded: set = set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+            for inner in ast.walk(node.value):
+                if isinstance(inner, ast.Name):
+                    shielded.add(id(inner))
+    return [
+        n
+        for n in ast.walk(expr)
+        if isinstance(n, ast.Name)
+        and n.id in traced
+        and id(n) not in shielded
+    ]
+
+
+def _function_scopes(tree: ast.Module):
+    """(scope_body, param_names) for the module and each def — nested defs
+    are yielded separately and excluded from their parent's body walk."""
+    yield _own_statements(tree.body), []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield _own_statements(node.body), param_names(node)
+
+
+def _own_statements(body):
+    """The statement list with nested function/class defs snipped out (they
+    form their own scopes)."""
+    return [
+        stmt
+        for stmt in body
+        if not isinstance(
+            stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        )
+    ]
+
+
+def _walk_no_nested_defs(stmts):
+    """Walk statements without descending into nested def/class bodies."""
+    stack = list(stmts)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            stack.append(child)
+
+
+# ---------------------------------------------------------- 1 jit-host-sync
+
+
+@register
+class JitHostSyncRule(Rule):
+    """Host-device syncs inside compiled code.
+
+    ``.item()``, ``np.asarray``, ``jax.device_get`` etc. inside a
+    ``jax.jit``/``shard_map``/``lax.scan`` body either fail at trace time
+    or — worse, under ``jax.debug``-style escapes — force a device->host
+    round trip that serializes the XLA pipeline. On TPU that's the
+    difference between a scan-epoch running as one program and a hot loop
+    bottlenecked on PCIe-sized latencies.
+    """
+
+    id = "jit-host-sync"
+    severity = "error"
+    description = (
+        "host-sync op (.item()/float()/np.array/jax.device_get) reachable "
+        "inside jit/shard_map/lax.scan-traced code"
+    )
+
+    _SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+    _NUMPY_ROOTS = {"np", "numpy", "onp"}
+    _NUMPY_PULLS = {"array", "asarray", "asanyarray", "frombuffer", "copy"}
+
+    def check(self, ctx: ModuleContext) -> Iterator:
+        for region in ctx.jit_regions:
+            for node in region.walk():
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                name = dotted_name(f)
+                if (
+                    isinstance(f, ast.Attribute)
+                    and f.attr in self._SYNC_METHODS
+                ):
+                    yield ctx.finding(
+                        self,
+                        node,
+                        f".{f.attr}() inside code traced via "
+                        f"{region.reason} — device->host sync; return the "
+                        "array and read it outside the compiled region",
+                    )
+                elif _tail(name) == "device_get" and _root(name) in (
+                    "jax",
+                    "device_get",
+                ):
+                    yield ctx.finding(
+                        self,
+                        node,
+                        f"jax.device_get inside code traced via "
+                        f"{region.reason} — host transfer in a compiled "
+                        "body; hoist it to the caller",
+                    )
+                elif (
+                    _root(name) in self._NUMPY_ROOTS
+                    and _tail(name) in self._NUMPY_PULLS
+                ):
+                    yield ctx.finding(
+                        self,
+                        node,
+                        f"{name}(...) inside code traced via "
+                        f"{region.reason} — numpy materializes on host; "
+                        "use jnp",
+                    )
+                elif (
+                    isinstance(f, ast.Name)
+                    and f.id in ("float", "int", "bool")
+                    and node.args
+                ):
+                    hits = _traced_name_hits(
+                        node.args[0], region.traced_params
+                    )
+                    if hits:
+                        yield ctx.finding(
+                            self,
+                            node,
+                            f"{f.id}({hits[0].id}) on a traced value "
+                            f"inside code traced via {region.reason} — "
+                            "concretization error / host sync; keep it a "
+                            "jnp array (shape/dtype reads are fine)",
+                        )
+
+
+# --------------------------------------------------------- 2 retrace-hazard
+
+
+@register
+class RetraceHazardRule(Rule):
+    """jit construction in places that defeat the trace cache.
+
+    ``jax.jit`` caches on the FUNCTION OBJECT: jit inside a loop, jit of a
+    fresh lambda, or build-and-immediately-call (``jax.jit(f)(x)``) inside
+    a function hands the cache a new key per call — a silent recompile
+    every iteration, which on TPU means seconds of XLA compile time paid
+    per step. Tests are exempt (skip_in_tests): one-shot jits in a test
+    body compile exactly once by construction.
+    """
+
+    id = "retrace-hazard"
+    severity = "warning"
+    skip_in_tests = True
+    description = (
+        "jax.jit constructed in a loop / of a fresh lambda / "
+        "built-and-called inline — defeats the trace cache, recompiles "
+        "per call"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator:
+        reported: set = set()
+
+        def report(node, msg):
+            if node.lineno not in reported:
+                reported.add(node.lineno)
+                yield ctx.finding(self, node, msg)
+
+        # stack-walk with loop/function depth
+        def visit(node, loops: int, funcs: int):
+            if isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
+                loops += 1
+            elif isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                funcs += 1
+            if isinstance(node, ast.Call):
+                if is_jit_wrapper(node.func):
+                    if loops:
+                        yield from report(
+                            node,
+                            f"{dotted_name(node.func)} constructed inside "
+                            "a loop — compiles every iteration; hoist it "
+                            "out (jit caches on the function object)",
+                        )
+                    elif funcs and node.args and isinstance(
+                        node.args[0], ast.Lambda
+                    ):
+                        yield from report(
+                            node,
+                            "jit of a lambda inside a function — a fresh "
+                            "function object per call means a fresh trace "
+                            "per call; def it at module scope",
+                        )
+                # jax.jit(f)(x) / jax.jit(f).lower(...) inside a function
+                if (
+                    funcs
+                    and isinstance(node.func, ast.Call)
+                    and is_jit_wrapper(node.func.func)
+                ):
+                    yield from report(
+                        node,
+                        "jit built and invoked in one expression inside a "
+                        "function — the compiled fn is discarded and "
+                        "re-traced on the next call; cache it",
+                    )
+                if (
+                    funcs
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("lower", "trace")
+                    and isinstance(node.func.value, ast.Call)
+                    and is_jit_wrapper(node.func.value.func)
+                ):
+                    yield from report(
+                        node,
+                        f"jit(...).{node.func.attr}() inside a function — "
+                        "re-traces every call unless the result is cached",
+                    )
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child, loops, funcs)
+
+        yield from visit(ctx.tree, 0, 0)
+
+
+# ------------------------------------------------- 3 static-argnames-mismatch
+
+
+@register
+class StaticArgnamesMismatchRule(Rule):
+    """``static_argnames`` naming a parameter that doesn't exist.
+
+    jax only validates static_argnames lazily (and historically only
+    warned), so a typo'd or stale name silently makes the argument TRACED
+    — every distinct Python value then recompiles instead of specializing,
+    and `if flag:` on it becomes a tracer error far from the typo.
+    """
+
+    id = "static-argnames-mismatch"
+    severity = "error"
+    description = (
+        "static_argnames/static_argnums referencing parameters absent "
+        "from the jitted function's signature"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator:
+        defs = {
+            n.name: n
+            for n in ast.walk(ctx.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+
+        def jit_call_sites():
+            # decorator form: @partial(jax.jit, static_argnames=...) /
+            # @jax.jit(static_argnames=...)
+            for fn in defs.values():
+                for dec in fn.decorator_list:
+                    if not isinstance(dec, ast.Call):
+                        continue
+                    if is_jit_wrapper(dec.func):
+                        yield dec, fn
+                    elif (
+                        dotted_name(dec.func)
+                        in ("partial", "functools.partial")
+                        and dec.args
+                        and is_jit_wrapper(dec.args[0])
+                    ):
+                        yield dec, fn
+            # call form: jax.jit(f, static_argnames=...) with local f
+            for node in ast.walk(ctx.tree):
+                if (
+                    isinstance(node, ast.Call)
+                    and is_jit_wrapper(node.func)
+                    and node.args
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in defs
+                ):
+                    yield node, defs[node.args[0].id]
+
+        seen: set = set()
+        for call, fn in jit_call_sites():
+            key = (call.lineno, call.col_offset)
+            if key in seen:
+                continue
+            seen.add(key)
+            sig = set(param_names(fn))
+            has_kwargs = fn.args.kwarg is not None
+            for kw in call.keywords:
+                if kw.arg == "static_argnames" and not has_kwargs:
+                    for name in literal_str_seq(kw.value) or []:
+                        if name not in sig:
+                            yield ctx.finding(
+                                self,
+                                call,
+                                f"static_argnames={name!r} is not a "
+                                f"parameter of {fn.name}() — the intended "
+                                "argument stays traced and recompiles per "
+                                "value",
+                            )
+                elif kw.arg == "static_argnums" and not fn.args.vararg:
+                    npos = len(fn.args.posonlyargs) + len(fn.args.args)
+                    nums = kw.value
+                    elts = (
+                        nums.elts
+                        if isinstance(nums, (ast.Tuple, ast.List))
+                        else [nums]
+                    )
+                    for elt in elts:
+                        if (
+                            isinstance(elt, ast.Constant)
+                            and isinstance(elt.value, int)
+                            and elt.value >= npos
+                        ):
+                            yield ctx.finding(
+                                self,
+                                call,
+                                f"static_argnums={elt.value} is out of "
+                                f"range for {fn.name}() ({npos} positional "
+                                "parameters)",
+                            )
+
+
+# ----------------------------------------------------------- 4 rng-key-reuse
+
+
+# jax.random callables that DERIVE rather than consume entropy; everything
+# else in jax.random consumes the key it's given.
+_KEY_DERIVERS = {"fold_in", "clone", "key_data", "wrap_key_data"}
+_KEY_MAKERS = {"PRNGKey", "key", "split", "fold_in", "clone"}
+
+
+def _is_jax_random(name: Optional[str]) -> bool:
+    if not name:
+        return False
+    parts = name.split(".")
+    return len(parts) >= 2 and parts[-2] == "random"
+
+
+@register
+class RngKeyReuseRule(Rule):
+    """A PRNG key consumed twice, or a constant key baked into library code.
+
+    JAX keys are single-use by contract: two draws from one key are
+    CORRELATED, not independent — e.g. cutout squares landing on the crop
+    offsets, or every serving replica "randomly" picking the same thing.
+    The repo's discipline (data/cifar.py) is fold_in(base, counter) then
+    split — fold_in/clone derive and are exempt; split and every sampler
+    consume. Constant ``PRNGKey(0)`` in library code pins every caller to
+    one stream (tests are exempt: determinism there is the point).
+    """
+
+    id = "rng-key-reuse"
+    severity = "error"
+    description = (
+        "PRNG key consumed twice without split, or constant PRNGKey in "
+        "library code"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator:
+        # --- part A: constant keys (library code only)
+        if not ctx.is_test:
+            for node in ast.walk(ctx.tree):
+                if (
+                    isinstance(node, ast.Call)
+                    and _is_jax_random(dotted_name(node.func))
+                    and _tail(dotted_name(node.func)) in ("PRNGKey", "key")
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, int)
+                ):
+                    yield ctx.finding(
+                        self,
+                        node,
+                        f"constant {_tail(dotted_name(node.func))}"
+                        f"({node.args[0].value}) in library code — every "
+                        "caller shares one stream; thread a seed/key in",
+                    )
+
+        # --- part B: per-scope double consumption
+        for body, params in _function_scopes(ctx.tree):
+            yield from self._check_scope(ctx, body, params)
+
+    _KEYISH_PARAM = ("key", "rng", "prng")
+
+    def _check_scope(self, ctx, body, params) -> Iterator:
+        findings: dict = {}  # (line, name) -> Finding
+        uses: dict = {}  # key name -> first-use line (0 = unconsumed)
+
+        # Parameters that are keys by naming convention are tracked too —
+        # `def f(key): a = normal(key); b = normal(key)` is the classic
+        # bug. Only when the scope actually hands them to jax.random,
+        # though: a numpy Generator named `rng` (data/imagenet.py crop
+        # sampling) is stateful and reuses legitimately.
+        keyish = [
+            p
+            for p in params
+            if any(tok in p.lower() for tok in self._KEYISH_PARAM)
+        ]
+        if keyish:
+            fed_to_jax_random: set = set()
+            for stmt in body:
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Call) and _is_jax_random(
+                        dotted_name(sub.func)
+                    ):
+                        fed_to_jax_random.update(_names_directly_under(sub))
+            for p in keyish:
+                if p in fed_to_jax_random:
+                    uses[p] = 0
+
+        def assign_target(t):
+            for name in _target_names(t):
+                uses.pop(name, None)
+
+        def is_key_producer(value) -> bool:
+            return (
+                isinstance(value, ast.Call)
+                and _is_jax_random(dotted_name(value.func))
+                and _tail(dotted_name(value.func)) in _KEY_MAKERS
+            )
+
+        def track_target(t):
+            for name in _target_names(t):
+                uses[name] = 0  # tracked, unconsumed
+
+        def consume(name, node):
+            if name not in uses:
+                return
+            if uses[name]:
+                key = (node.lineno, name)
+                if key not in findings:
+                    findings[key] = ctx.finding(
+                        self,
+                        node,
+                        f"PRNG key {name!r} consumed again (first use "
+                        f"line {uses[name]}) without an intervening "
+                        "split/fold_in — draws will be correlated",
+                    )
+            else:
+                uses[name] = node.lineno
+
+        def visit_expr(node):
+            # Names are attributed to the INNERMOST call receiving them, so
+            # normal(fold_in(key, i)) charges `key` to the exempt fold_in,
+            # not to normal.
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                fname = dotted_name(sub.func)
+                if _is_jax_random(fname) and _tail(fname) in _KEY_DERIVERS:
+                    continue
+                for name in set(_names_directly_under(sub)):
+                    consume(name, sub)
+
+        def visit_stmts(stmts):
+            for stmt in stmts:
+                if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    value = stmt.value
+                    if value is not None:
+                        visit_expr(value)
+                    targets = (
+                        stmt.targets
+                        if isinstance(stmt, ast.Assign)
+                        else [stmt.target]
+                    )
+                    for t in targets:
+                        if value is not None and is_key_producer(value):
+                            track_target(t)
+                        else:
+                            assign_target(t)
+                elif isinstance(stmt, ast.If):
+                    visit_expr(stmt.test)
+                    snapshot = dict(uses)
+                    visit_stmts(_own_statements(stmt.body))
+                    after_body = dict(uses)
+                    uses.clear()
+                    uses.update(snapshot)
+                    visit_stmts(_own_statements(stmt.orelse))
+                    # A branch ending in return/raise doesn't leak its
+                    # consumptions into the fall-through path (the idiom
+                    # `if m == "snip": return snip(.., rng)` chains).
+                    body_live = not _terminates(stmt.body)
+                    else_live = not (
+                        stmt.orelse and _terminates(stmt.orelse)
+                    )
+                    if body_live and not else_live:
+                        uses.clear()
+                        uses.update(after_body)
+                    elif body_live:
+                        for name, line in after_body.items():
+                            uses[name] = max(uses.get(name, 0), line)
+                elif isinstance(stmt, (ast.For, ast.While, ast.AsyncFor)):
+                    # Two symbolic iterations: a key defined outside the
+                    # loop and consumed inside without per-iteration
+                    # rederivation trips on pass two — cross-iteration
+                    # reuse. Findings dedupe on (line, name).
+                    loop_body = _own_statements(stmt.body)
+                    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                        visit_expr(stmt.iter)
+                        assign_target(stmt.target)
+                    else:
+                        visit_expr(stmt.test)
+                    visit_stmts(loop_body)
+                    visit_stmts(loop_body)
+                    visit_stmts(_own_statements(stmt.orelse))
+                elif isinstance(stmt, ast.Try):
+                    visit_stmts(_own_statements(stmt.body))
+                    for h in stmt.handlers:
+                        visit_stmts(_own_statements(h.body))
+                    visit_stmts(_own_statements(stmt.orelse))
+                    visit_stmts(_own_statements(stmt.finalbody))
+                elif isinstance(
+                    stmt, (ast.With, ast.AsyncWith)
+                ):
+                    for item in stmt.items:
+                        visit_expr(item.context_expr)
+                    visit_stmts(_own_statements(stmt.body))
+                elif isinstance(
+                    stmt,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+                ):
+                    continue  # separate scope
+                else:
+                    visit_expr(stmt)
+
+        visit_stmts(body)
+        yield from findings.values()
+
+
+# --------------------------------------------------------- 5 collective-order
+
+
+@register
+class CollectiveOrderRule(Rule):
+    """Collectives under rank-conditional control flow.
+
+    Every collective must be issued by EVERY process in the same order —
+    a ``psum``/``broadcast_one_to_all`` under ``if process_index() == 0:``
+    (or ``is_primary()``) runs on one host only, and the rest of the pod
+    blocks in the next collective forever. Multihost deadlocks like this
+    have no traceback: the job just hangs until the scheduler kills it.
+    Uniform guards (``process_count() == 1``) are fine and not flagged.
+    """
+
+    id = "collective-order"
+    severity = "error"
+    description = (
+        "collective op inside a process_index()/is_primary()-conditional "
+        "branch — not all hosts reach it; multihost deadlock"
+    )
+
+    # jax collectives + multihost utils + this repo's collective-bearing
+    # wrappers (parallel/multihost.py).
+    _COLLECTIVES = {
+        "psum",
+        "pmean",
+        "pmax",
+        "pmin",
+        "all_gather",
+        "all_to_all",
+        "ppermute",
+        "pshuffle",
+        "psum_scatter",
+        "broadcast_one_to_all",
+        "process_allgather",
+        "sync_global_devices",
+        "assert_equal",
+        "broadcast_object",
+        "sync_hosts",
+        "check_state_equality",
+    }
+    _RANK_SOURCES = {"process_index", "is_primary"}
+
+    def check(self, ctx: ModuleContext) -> Iterator:
+        seen: set = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.If):
+                continue
+            test_names = {
+                _tail(dotted_name(n))
+                for n in ast.walk(node.test)
+                if dotted_name(n)
+            }
+            if not (test_names & self._RANK_SOURCES):
+                continue
+            for branch in (node.body, node.orelse):
+                for stmt in branch:
+                    for sub in ast.walk(stmt):
+                        if (
+                            isinstance(sub, ast.Call)
+                            and _tail(dotted_name(sub.func))
+                            in self._COLLECTIVES
+                            and (sub.lineno, sub.col_offset) not in seen
+                        ):
+                            seen.add((sub.lineno, sub.col_offset))
+                            yield ctx.finding(
+                                self,
+                                sub,
+                                f"{dotted_name(sub.func)} under a "
+                                "process_index()/is_primary() branch — "
+                                "hosts that skip the branch never post "
+                                "the collective and the pod deadlocks; "
+                                "run it unconditionally and mask the "
+                                "result instead",
+                            )
+
+
+# -------------------------------------------------------- 6 donated-arg-reuse
+
+
+@register
+class DonatedArgReuseRule(Rule):
+    """Reading a buffer after donating it to a jit.
+
+    ``donate_argnums`` lets XLA alias the argument's HBM for the output
+    (parallel/mesh.py relies on it so the optimizer update is in-place).
+    The cost: the Python-side array is left pointing at freed/aliased
+    memory — reads after the call return garbage or raise, depending on
+    backend and timing. The safe idiom is exactly what the harness does:
+    rebind the result over the donated name (``state = step(state, ...)``).
+    """
+
+    id = "donated-arg-reuse"
+    severity = "error"
+    description = (
+        "argument read after being passed to a donate_argnums jit — the "
+        "buffer was donated and may alias the output"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator:
+        for body, _params in _function_scopes(ctx.tree):
+            yield from self._check_scope(ctx, body)
+
+    @staticmethod
+    def _donation_spec(call: ast.Call):
+        """(argnums, argnames) from a jit-wrapper call, or None."""
+        if not is_jit_wrapper(call.func):
+            return None
+        nums, names = [], []
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                v = kw.value
+                elts = (
+                    v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+                )
+                for e in elts:
+                    if isinstance(e, ast.Constant) and isinstance(
+                        e.value, int
+                    ):
+                        nums.append(e.value)
+            elif kw.arg == "donate_argnames":
+                names.extend(literal_str_seq(kw.value) or [])
+        return (tuple(nums), tuple(names)) if (nums or names) else None
+
+    def _check_scope(self, ctx, body) -> Iterator:
+        donators: dict = {}  # callable name -> (argnums, argnames)
+        dead: dict = {}  # donated var name -> donation line
+        findings: dict = {}
+
+        def donate_from_call(call: ast.Call, spec) -> None:
+            nums, names = spec
+            for i in nums:
+                if i < len(call.args):
+                    name = dotted_name(call.args[i])
+                    if name:
+                        dead[name] = call.lineno
+            for kw in call.keywords:
+                if kw.arg in names:
+                    name = dotted_name(kw.value)
+                    if name:
+                        dead[name] = call.lineno
+
+        def flag_dead_reads(expr) -> None:
+            for n in ast.walk(expr):
+                name = dotted_name(n)
+                if (
+                    name in dead
+                    and isinstance(n, (ast.Name, ast.Attribute))
+                    and isinstance(getattr(n, "ctx", None), ast.Load)
+                ):
+                    key = (n.lineno, name)
+                    if key not in findings:
+                        findings[key] = ctx.finding(
+                            self,
+                            n,
+                            f"{name!r} read after being donated at line "
+                            f"{dead[name]} — the buffer was handed to XLA "
+                            "and may be deleted/aliased; rebind the jit's "
+                            "result instead",
+                        )
+
+        def revive_target(t) -> None:
+            for name in _target_names(t):
+                dead.pop(name, None)
+
+        def visit_expr(expr) -> None:
+            # Reads of buffers killed by PRIOR statements flag first; only
+            # then do this statement's own donations take effect (the arg
+            # handed to the donating call is a legal last read).
+            flag_dead_reads(expr)
+            for sub in ast.walk(expr):
+                if not isinstance(sub, ast.Call):
+                    continue
+                fname = dotted_name(sub.func)
+                spec = None
+                if fname is not None and fname in donators:
+                    spec = donators[fname]
+                elif isinstance(sub.func, ast.Call):
+                    spec = self._donation_spec(sub.func)  # jit(f, ...)(x)
+                if spec is not None:
+                    donate_from_call(sub, spec)
+
+        def visit_stmts(stmts) -> None:
+            for stmt in stmts:
+                if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    value = stmt.value
+                    targets = (
+                        stmt.targets
+                        if isinstance(stmt, ast.Assign)
+                        else [stmt.target]
+                    )
+                    if value is not None:
+                        spec = (
+                            self._donation_spec(value)
+                            if isinstance(value, ast.Call)
+                            else None
+                        )
+                        if spec is not None:
+                            # g = jax.jit(f, donate_argnums=...)
+                            for t in targets:
+                                name = dotted_name(t)
+                                if name:
+                                    donators[name] = spec
+                            continue
+                        visit_expr(value)
+                    for t in targets:
+                        revive_target(t)
+                elif isinstance(stmt, ast.If):
+                    visit_expr(stmt.test)
+                    snapshot = dict(dead)
+                    visit_stmts(_own_statements(stmt.body))
+                    after = dict(dead)
+                    dead.clear()
+                    dead.update(snapshot)
+                    visit_stmts(_own_statements(stmt.orelse))
+                    body_live = not _terminates(stmt.body)
+                    else_live = not (
+                        stmt.orelse and _terminates(stmt.orelse)
+                    )
+                    if body_live and not else_live:
+                        dead.clear()
+                        dead.update(after)
+                    elif body_live:
+                        dead.update(after)  # dead in either branch: dead
+                elif isinstance(stmt, (ast.For, ast.While, ast.AsyncFor)):
+                    loop_body = _own_statements(stmt.body)
+                    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                        visit_expr(stmt.iter)
+                        revive_target(stmt.target)
+                    else:
+                        visit_expr(stmt.test)
+                    visit_stmts(loop_body)
+                    visit_stmts(loop_body)  # cross-iteration reuse
+                    visit_stmts(_own_statements(stmt.orelse))
+                elif isinstance(stmt, ast.Try):
+                    visit_stmts(_own_statements(stmt.body))
+                    for h in stmt.handlers:
+                        visit_stmts(_own_statements(h.body))
+                    visit_stmts(_own_statements(stmt.orelse))
+                    visit_stmts(_own_statements(stmt.finalbody))
+                elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    for item in stmt.items:
+                        visit_expr(item.context_expr)
+                    visit_stmts(_own_statements(stmt.body))
+                elif isinstance(
+                    stmt,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+                ):
+                    continue
+                else:
+                    visit_expr(stmt)
+
+        visit_stmts(body)
+        yield from findings.values()
+
+
+# ------------------------------------------------------------ 7 broad-except
+
+
+@register
+class BroadExceptRule(Rule):
+    """``except:``/``except Exception:`` that swallows silently.
+
+    PR 1's root-cause was a config knob that silently did nothing; broad
+    handlers are how such bugs hide — an OOM, a shape error, a corrupt
+    checkpoint all collapse into "the fallback path ran". A broad catch is
+    acceptable only when it RECORDS what it ate (log/print/traceback) or
+    re-raises; genuine degrade-don't-die paths that report through other
+    channels (e.g. serve/batcher.py futures) carry an inline waiver whose
+    reason documents the channel.
+    """
+
+    id = "broad-except"
+    severity = "warning"
+    description = (
+        "bare/Exception-wide except that neither logs, re-raises, nor "
+        "records the suppressed error"
+    )
+
+    _BROAD = {"Exception", "BaseException"}
+    _EVIDENCE_CALLS = {
+        "print",
+        "warn",
+        "warning",
+        "error",
+        "exception",
+        "critical",
+        "info",
+        "debug",
+        "log",
+        "format_exc",
+        "print_exc",
+        "fail",
+    }
+    _EVIDENCE_ROOTS = {"logging", "logger", "warnings", "traceback", "log"}
+
+    def _is_broad(self, handler: ast.ExceptHandler) -> Optional[str]:
+        t = handler.type
+        if t is None:
+            return "bare except"
+        names = (
+            [dotted_name(e) for e in t.elts]
+            if isinstance(t, ast.Tuple)
+            else [dotted_name(t)]
+        )
+        for name in names:
+            if name and _tail(name) in self._BROAD:
+                return f"except {_tail(name)}"
+        return None
+
+    def _has_evidence(self, handler: ast.ExceptHandler) -> bool:
+        for node in ast.walk(handler):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if (
+                    _tail(name) in self._EVIDENCE_CALLS
+                    or _root(name) in self._EVIDENCE_ROOTS
+                ):
+                    return True
+        return False
+
+    def check(self, ctx: ModuleContext) -> Iterator:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            broad = self._is_broad(node)
+            if broad and not self._has_evidence(node):
+                yield ctx.finding(
+                    self,
+                    node,
+                    f"{broad} swallows the error without logging or "
+                    "re-raising — narrow the type, or record what was "
+                    "suppressed so real failures stay visible",
+                )
+
+
+# ------------------------------------------------------- 8 debug-in-hot-path
+
+
+@register
+class DebugInHotPathRule(Rule):
+    """Debug output inside compiled code.
+
+    A ``print`` inside a jitted body fires at TRACE time only (misleading:
+    it prints tracers, once) and ``jax.debug.print``/``callback`` inserts
+    a host callback into the compiled program — fine while debugging,
+    but in a scan-epoch hot path it stalls the device every step. Neither
+    belongs in committed library code.
+    """
+
+    id = "debug-in-hot-path"
+    severity = "warning"
+    description = (
+        "print/jax.debug.print/breakpoint inside jit-traced code — "
+        "trace-time noise or a per-step host callback in the hot path"
+    )
+
+    _DEBUG_TAILS = {"set_trace", "breakpoint"}
+
+    def check(self, ctx: ModuleContext) -> Iterator:
+        for region in ctx.jit_regions:
+            for node in region.walk():
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func) or ""
+                msg = None
+                if name in ("print", "breakpoint"):
+                    msg = (
+                        f"{name}() inside code traced via {region.reason}"
+                        " — executes at trace time only (prints tracers "
+                        "once, then never again)"
+                    )
+                elif ".debug." in f".{name}." and _tail(name) in (
+                    "print",
+                    "breakpoint",
+                    "callback",
+                ):
+                    msg = (
+                        f"{name} inside code traced via {region.reason} — "
+                        "host callback compiled into the hot path; remove "
+                        "before committing"
+                    )
+                elif _tail(name) in self._DEBUG_TAILS and _root(name) in (
+                    "pdb",
+                    "ipdb",
+                ):
+                    msg = f"{name} inside jit-traced code"
+                if msg:
+                    yield ctx.finding(self, node, msg)

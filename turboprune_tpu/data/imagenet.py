@@ -36,7 +36,7 @@ try:  # grain is present in the standard image; gate anyway.
     import grain.python as grain
 
     HAS_GRAIN = True
-except Exception:  # pragma: no cover
+except ImportError:  # pragma: no cover — a BROKEN install should raise
     grain = None
     HAS_GRAIN = False
 

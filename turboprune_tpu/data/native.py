@@ -152,7 +152,10 @@ class TpkFile:
     def __del__(self):  # pragma: no cover
         try:
             self.close()
-        except Exception:
+        except (AttributeError, TypeError, OSError):
+            # Interpreter shutdown: the ctypes lib / globals may already be
+            # torn down. Anything else (double-free, bad handle) should not
+            # be silenced — it means the reader itself is broken.
             pass
 
     def read_raw(

@@ -55,7 +55,9 @@ def _distributed_initialized() -> bool:
         from jax._src.distributed import global_state
 
         return global_state.client is not None
-    except Exception:
+    except (ImportError, AttributeError):
+        # Internal-module layout changed on this jax version: treat as not
+        # initialized (the subsequent initialize() raises loudly if wrong).
         return False
 
 

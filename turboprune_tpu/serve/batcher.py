@@ -149,6 +149,7 @@ class DynamicBatcher:
         )
         try:
             logits = self.engine.predict(images)
+        # graftlint: disable=broad-except -- degrade-don't-die: the error is delivered to every caller via future.set_exception and counted in errors_total; the batcher thread must survive any engine failure
         except Exception as e:  # surface to every caller, keep serving
             if self.metrics:
                 self.metrics.inc("errors_total", len(batch))

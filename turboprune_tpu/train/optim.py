@@ -60,7 +60,10 @@ def eval_params(opt_state, params):
     wrapper is active, the raw params otherwise."""
     try:
         return optax.contrib.schedule_free_eval_params(opt_state, params)
-    except Exception:
+    except (AttributeError, TypeError, ValueError):
+        # Non-schedule-free state (plain optax chain tuple): no .z/.b1 to
+        # average over — evaluate the raw params. A schedule-free state
+        # failing for any OTHER reason propagates.
         return params
 
 

@@ -175,20 +175,24 @@ def display_training_info(cfg: MainConfig, level: int, density: float) -> None:
         from rich.console import Console
         from rich.panel import Panel
         from rich.table import Table
-
-        console = Console()
-        t = Table(title=f"Level {level} — density {density:.4f}")
-        t.add_column("knob")
-        t.add_column("value")
-        for section in (
-            "dataset_params",
-            "model_params",
-            "pruning_params",
-            "optimizer_params",
-        ):
-            sub = getattr(cfg, section)
-            for f in dataclasses.fields(sub):
-                t.add_row(f"{section}.{f.name}", str(getattr(sub, f.name)))
-        console.print(Panel(t, border_style="cyan", expand=False))
-    except Exception:
+    except ImportError:
+        # Only a MISSING rich degrades to the plain print — a render error
+        # with rich present propagates (it would mean the config itself is
+        # broken, which must not be swallowed).
         print(f"[level {level}] density={density:.4f}")
+        return
+
+    console = Console()
+    t = Table(title=f"Level {level} — density {density:.4f}")
+    t.add_column("knob")
+    t.add_column("value")
+    for section in (
+        "dataset_params",
+        "model_params",
+        "pruning_params",
+        "optimizer_params",
+    ):
+        sub = getattr(cfg, section)
+        for f in dataclasses.fields(sub):
+            t.add_row(f"{section}.{f.name}", str(getattr(sub, f.name)))
+    console.print(Panel(t, border_style="cyan", expand=False))
