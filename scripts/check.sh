@@ -1,15 +1,29 @@
 #!/usr/bin/env bash
-# Pre-PR gate: graftlint over the package + tests, then the tier-1 fast
-# test suite (the same command ROADMAP.md pins). Exits nonzero if either
-# fails. Run from anywhere: paths resolve relative to the repo root.
+# Pre-PR gate, three stages:
+#   1. graftlint --changed      — per-file rules on just the .py files
+#      changed vs main (fast half; stays O(diff) as the repo grows)
+#   2. graftlint --project      — whole-project mode: per-file rules over
+#      everything PLUS the interprocedural call-chain analysis PLUS the
+#      conf/ <-> schema cross-checks. This is the real gate; it is the
+#      same invocation tests/test_analysis.py's self-gate pins at zero
+#      unwaived findings and zero stale waivers.
+#   3. tier-1 fast tests        — the same command ROADMAP.md pins,
+#      including its plugin surface (-p no:xdist -p no:randomly), so the
+#      gate and tier-1 agree on what "the suite" is.
+# Exits nonzero if any stage fails. Run from anywhere: paths resolve
+# relative to the repo root.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== graftlint (turboprune_tpu + tests) =="
-python -m turboprune_tpu.analysis turboprune_tpu tests
+echo "== graftlint --changed (per-file, vs main) =="
+python -m turboprune_tpu.analysis --changed
+
+echo "== graftlint --project (interprocedural + config rules) =="
+python -m turboprune_tpu.analysis --project turboprune_tpu conf tests
 
 echo "== tier-1 tests (fast tier, CPU) =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
-    --continue-on-collection-errors -p no:cacheprovider
+    --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly
 
 echo "check.sh: all gates passed"

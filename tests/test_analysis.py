@@ -1,6 +1,6 @@
 """graftlint (turboprune_tpu.analysis) tests.
 
-Three layers, mirroring the subsystem's contract:
+Four layers, mirroring the subsystem's contract:
 
 1. Per-rule fixtures: every rule has a BAD snippet it must catch and a
    GOOD twin it must stay silent on — the rule set's behavior is pinned
@@ -8,12 +8,17 @@ Three layers, mirroring the subsystem's contract:
    before it floods (or silently stops protecting) the repo.
 2. Engine mechanics: waiver parsing/scoping/reasons, test-file rule
    relaxations, reporter shapes, CLI exit codes.
-3. The SELF-GATE: the analyzer runs over the whole package + tests and
-   asserts zero unwaived findings and zero stale waivers. This is the test
-   that makes the rule set self-enforcing: any future PR that introduces a
-   host sync in a jitted body, reuses a key, or swallows an exception
-   fails tier-1 until the code is fixed or the site carries a reasoned
-   inline waiver.
+3. PROJECT-MODE fixtures (PR 3): every interprocedural upgrade has a
+   catching/non-catching pair SPANNING MODULES (the per-file layer's
+   documented blind spot), and every config rule has a yaml pair checked
+   against a fixture schema; call-path traces and yaml waivers are pinned
+   the same way.
+4. The SELF-GATE: the analyzer runs over the whole package + conf + tests
+   in both per-file and --project mode and asserts zero unwaived findings
+   and zero stale waivers. This is the test that makes the rule set
+   self-enforcing: any future PR that introduces a host sync N calls deep
+   in a jitted region, a typo'd conf key, or a swallowed exception fails
+   tier-1 until the code is fixed or the site carries a reasoned waiver.
 """
 
 from __future__ import annotations
@@ -25,8 +30,10 @@ from pathlib import Path
 import pytest
 
 from turboprune_tpu.analysis import (
+    CONF_RULES,
     RULES,
     analyze_paths,
+    analyze_project,
     analyze_source,
     render_json,
     render_text,
@@ -496,7 +503,7 @@ class TestReportersAndCli:
     def test_json_reporter_shape(self, tmp_path):
         bad = self._write(tmp_path, "bad.py", FIXTURES["broad-except"][0])
         payload = json.loads(render_json(analyze_paths([bad])))
-        assert payload["version"] == 1
+        assert payload["version"] == 2
         assert payload["files_analyzed"] == 1
         assert payload["summary"]["unwaived"] >= 1
         assert payload["summary"]["by_rule"].get("broad-except", 0) >= 1
@@ -514,7 +521,9 @@ class TestReportersAndCli:
             "message",
             "waived",
             "waiver_reason",
+            "trace",
         }
+        assert f["trace"] is None  # per-file findings carry no call path
         assert payload["unused_waivers"] == []
 
     def test_text_reporter_grepable(self, tmp_path):
@@ -541,7 +550,21 @@ class TestReportersAndCli:
 
 
 class TestSelfGate:
-    """The rule set enforces itself on every future PR."""
+    """The rule set enforces itself on every future PR.
+
+    Two layers: the per-file gate (unchanged from PR 2) and the PROJECT
+    gate — the same ``--project turboprune_tpu conf tests`` invocation
+    scripts/check.sh runs, covering the interprocedural rules and the
+    config rules too. Stale-waiver accounting lives on the project gate
+    because only project mode can fire every rule a waiver may name (a
+    conf-dead-schema-field waiver in schema.py is invisible to the
+    per-file pass by construction)."""
+
+    @pytest.fixture(scope="class")
+    def project_result(self):
+        return analyze_project(
+            [REPO / "turboprune_tpu", REPO / "conf", REPO / "tests"]
+        )
 
     def test_package_and_tests_have_zero_unwaived_findings(self):
         result = analyze_paths(
@@ -557,7 +580,20 @@ class TestSelfGate:
             + msg
         )
 
-    def test_no_stale_waivers(self):
+    def test_project_mode_has_zero_unwaived_findings(self, project_result):
+        msg = "\n".join(
+            f"  {f.file}:{f.line}: [{f.rule}] {f.message}"
+            + (f"\n    call path: {' -> '.join(f.trace)}" if f.trace else "")
+            for f in project_result.unwaived
+        )
+        assert not project_result.unwaived, (
+            "graftlint --project found unwaived findings — fix them or "
+            "waive with a reason (YAML comments work in conf/):\n" + msg
+        )
+
+    def test_no_stale_waivers_per_file_scope(self):
+        """Per-file mode must not report its OWN rules' waivers stale
+        (project-scope conf-* waivers are excluded by design)."""
         result = analyze_paths(
             [REPO / "turboprune_tpu", REPO / "tests"]
         )
@@ -570,11 +606,741 @@ class TestSelfGate:
             "nothing):\n" + stale
         )
 
-    def test_every_package_waiver_has_a_reason(self):
-        result = analyze_paths([REPO / "turboprune_tpu"])
+    def test_no_stale_waivers_project(self, project_result):
+        stale = "\n".join(
+            f"  {w.file}:{w.line}: {sorted(w.rules)}"
+            for w in project_result.unused_waivers
+        )
+        assert not project_result.unused_waivers, (
+            "waivers matching no finding under --project (remove them, "
+            "they mask nothing):\n" + stale
+        )
+
+    def test_every_package_waiver_has_a_reason(self, project_result):
         missing = [
-            f"{w.file}:{w.line}" for w in result.waivers if not w.reason
+            f"{w.file}:{w.line}"
+            for w in project_result.waivers
+            if not w.reason
+            and str(REPO / "turboprune_tpu") in w.file
         ]
         assert not missing, (
             "package waivers must document WHY: " + ", ".join(missing)
         )
+
+    def test_cli_project_gate_exits_zero(self, capsys):
+        rc = cli_main(
+            [
+                "--project",
+                str(REPO / "turboprune_tpu"),
+                str(REPO / "conf"),
+                str(REPO / "tests"),
+            ]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0, out
+
+
+# =================================================================
+# PR 3: whole-project mode — interprocedural + config rule fixtures
+# =================================================================
+
+
+def write_project(tmp_path, files: dict) -> Path:
+    """Materialize ``{relpath: source}`` under tmp_path/proj."""
+    proj = tmp_path / "proj"
+    for rel, src in files.items():
+        p = proj / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return proj
+
+
+def run_project(tmp_path, files: dict, paths=None):
+    proj = write_project(tmp_path, files)
+    result = analyze_project([proj] if paths is None else [proj / p for p in paths])
+    return result
+
+
+def unwaived(result, rule_id=None):
+    out = [f for f in result.findings if not f.waived]
+    if rule_id:
+        out = [f for f in out if f.rule == rule_id]
+    return out
+
+
+# Every interprocedural upgrade: (rule, bad files, good files). Each pair
+# spans TWO modules — the whole point is firing across the file boundary
+# the per-file layer documents as its blind spot.
+INTERPROC_FIXTURES = {
+    "jit-host-sync": (
+        {
+            "pkg/__init__.py": "",
+            "pkg/helpers.py": """
+                import numpy as np
+
+                def to_host(x):
+                    return np.asarray(x)
+            """,
+            "pkg/main.py": """
+                import jax
+                from .helpers import to_host
+
+                @jax.jit
+                def step(state, batch):
+                    return to_host(state) + batch
+            """,
+        },
+        {
+            "pkg/__init__.py": "",
+            "pkg/helpers.py": """
+                import jax.numpy as jnp
+
+                def to_dev(x):
+                    return jnp.asarray(x)
+            """,
+            "pkg/main.py": """
+                import jax
+                from .helpers import to_dev
+
+                @jax.jit
+                def step(state, batch):
+                    return to_dev(state) + batch
+            """,
+        },
+    ),
+    "collective-order": (
+        {
+            "pkg/__init__.py": "",
+            "pkg/ckpt.py": """
+                import jax
+
+                def barrier(name):
+                    if jax.process_count() > 1:
+                        from jax.experimental import multihost_utils
+                        multihost_utils.sync_global_devices(name)
+
+                def save_all(tree, path):
+                    del tree, path
+                    barrier("save")
+            """,
+            "pkg/main.py": """
+                import jax
+                from .ckpt import save_all
+
+                def checkpoint(tree):
+                    if jax.process_index() == 0:
+                        save_all(tree, "/tmp/x")
+            """,
+        },
+        {
+            "pkg/__init__.py": "",
+            "pkg/ckpt.py": """
+                import jax
+
+                def barrier(name):
+                    if jax.process_count() > 1:
+                        from jax.experimental import multihost_utils
+                        multihost_utils.sync_global_devices(name)
+
+                def save_all(tree, path):
+                    del tree, path
+                    barrier("save")
+            """,
+            "pkg/main.py": """
+                import jax
+                from .ckpt import save_all
+
+                def checkpoint(tree):
+                    # every host reaches the collective; only the print is
+                    # rank-conditional
+                    save_all(tree, "/tmp/x")
+                    if jax.process_index() == 0:
+                        print("saved")
+            """,
+        },
+    ),
+    "rng-key-reuse": (
+        {
+            "pkg/__init__.py": "",
+            "pkg/samplers.py": """
+                import jax
+
+                def draw(k, shape=(2,)):
+                    return jax.random.normal(k, shape)
+            """,
+            "pkg/main.py": """
+                from .samplers import draw
+
+                def sample(key):
+                    a = draw(key)
+                    b = draw(key)
+                    return a + b
+            """,
+        },
+        {
+            "pkg/__init__.py": "",
+            "pkg/samplers.py": """
+                import jax
+
+                def draw(k, shape=(2,)):
+                    return jax.random.normal(k, shape)
+            """,
+            "pkg/main.py": """
+                import jax
+                from .samplers import draw
+
+                def sample(key):
+                    k1, k2 = jax.random.split(key)
+                    a = draw(k1)
+                    b = draw(k2)
+                    return a + b
+            """,
+        },
+    ),
+    "donated-arg-reuse": (
+        {
+            "pkg/__init__.py": "",
+            "pkg/mesh.py": """
+                import jax
+
+                def make_step(fn):
+                    return jax.jit(fn, donate_argnums=(0,))
+            """,
+            "pkg/main.py": """
+                from .mesh import make_step
+
+                def run(fn, state, batch):
+                    step = make_step(fn)
+                    new_state, metrics = step(state, batch)
+                    drift = state.mean()
+                    return new_state, metrics, drift
+            """,
+        },
+        {
+            "pkg/__init__.py": "",
+            "pkg/mesh.py": """
+                import jax
+
+                def make_step(fn):
+                    return jax.jit(fn, donate_argnums=(0,))
+            """,
+            "pkg/main.py": """
+                from .mesh import make_step
+
+                def run(fn, state, batch):
+                    step = make_step(fn)
+                    state, metrics = step(state, batch)
+                    drift = state.mean()
+                    return state, metrics, drift
+            """,
+        },
+    ),
+    "retrace-hazard": (
+        {
+            "pkg/__init__.py": "",
+            "pkg/factory.py": """
+                import jax
+
+                def compile_step(fn):
+                    return jax.jit(fn)
+            """,
+            "pkg/main.py": """
+                from .factory import compile_step
+
+                def train(fn, batches, x):
+                    for b in batches:
+                        step = compile_step(fn)
+                        x = step(x, b)
+                    return x
+            """,
+        },
+        {
+            "pkg/__init__.py": "",
+            "pkg/factory.py": """
+                import jax
+
+                def compile_step(fn):
+                    return jax.jit(fn)
+            """,
+            "pkg/main.py": """
+                from .factory import compile_step
+
+                def train(fn, batches, x):
+                    step = compile_step(fn)
+                    for b in batches:
+                        x = step(x, b)
+                    return x
+            """,
+        },
+    ),
+}
+
+
+class TestInterprocFixtures:
+    @pytest.mark.parametrize("rule_id", sorted(INTERPROC_FIXTURES))
+    def test_bad_caught_across_modules(self, rule_id, tmp_path):
+        bad, _ = INTERPROC_FIXTURES[rule_id]
+        result = run_project(tmp_path, bad)
+        hits = unwaived(result, rule_id)
+        assert hits, f"{rule_id} missed its cross-module bad fixture"
+        # an interprocedural finding must carry its call-path trace
+        assert any(f.trace for f in hits), (
+            f"{rule_id} fired without a trace: "
+            f"{[(f.line, f.message) for f in hits]}"
+        )
+
+    @pytest.mark.parametrize("rule_id", sorted(INTERPROC_FIXTURES))
+    def test_good_twin_silent(self, rule_id, tmp_path):
+        _, good = INTERPROC_FIXTURES[rule_id]
+        result = run_project(tmp_path, good)
+        hits = unwaived(result, rule_id)
+        assert not hits, (
+            f"{rule_id} false-positived on its cross-module good twin: "
+            f"{[(f.file, f.line, f.message) for f in hits]}"
+        )
+
+    def test_closure_factory_chain_spans_three_modules(self, tmp_path):
+        """The flagship blind spot: a closure returned by one factory,
+        jitted by another module's factory, reaching a host sync in a
+        third module (train/steps.py -> parallel/mesh.py -> ops/*)."""
+        files = {
+            "pkg/__init__.py": "",
+            "pkg/ops.py": """
+                import numpy as np
+
+                def pull(x):
+                    return np.asarray(x)
+            """,
+            "pkg/steps.py": """
+                from .ops import pull
+
+                def make_train_step(model):
+                    def train_step(state, batch):
+                        return pull(state) + batch
+                    return train_step
+            """,
+            "pkg/mesh.py": """
+                import jax
+
+                def make_sharded(train_step, mesh):
+                    del mesh
+                    return jax.jit(train_step, donate_argnums=(0,))
+            """,
+            "pkg/harness.py": """
+                from .mesh import make_sharded
+                from .steps import make_train_step
+
+                def wire(model, mesh):
+                    raw = make_train_step(model)
+                    return make_sharded(raw, mesh)
+            """,
+        }
+        result = run_project(tmp_path, files)
+        hits = unwaived(result, "jit-host-sync")
+        assert hits, "closure-factory jit entry not detected"
+        (f,) = [h for h in hits if "ops.py" in h.file]
+        assert f.trace and any("train_step" in hop for hop in f.trace)
+        assert any("make_sharded" in hop for hop in f.trace)
+
+    def test_interproc_finding_waivable_inline(self, tmp_path):
+        bad, _ = INTERPROC_FIXTURES["jit-host-sync"]
+        files = dict(bad)
+        files["pkg/helpers.py"] = """
+            import numpy as np
+
+            def to_host(x):
+                # trace-time constant pull, proven static
+                # graftlint: disable=jit-host-sync -- trace-time constant; never a device tensor
+                return np.asarray(x)
+        """
+        result = run_project(tmp_path, files)
+        assert not unwaived(result, "jit-host-sync")
+        waived = [
+            f
+            for f in result.findings
+            if f.waived and f.rule == "jit-host-sync"
+        ]
+        assert waived and waived[0].waiver_reason.startswith("trace-time")
+
+    def test_cached_factory_in_loop_is_fine(self, tmp_path):
+        """An accessor with a cache-lookup early return (serve/engine.py's
+        _executable) is NOT 'builds a fresh jit every call' — looping on
+        it must stay silent."""
+        files = {
+            "pkg/__init__.py": "",
+            "pkg/engine.py": """
+                import jax
+
+                _CACHE = {}
+
+                def executable(fn, bucket):
+                    hit = _CACHE.get(bucket)
+                    if hit is not None:
+                        return hit
+                    compiled = jax.jit(fn)
+                    _CACHE[bucket] = compiled
+                    return compiled
+            """,
+            "pkg/main.py": """
+                from .engine import executable
+
+                def warmup(fn, buckets):
+                    for b in buckets:
+                        executable(fn, b)
+            """,
+        }
+        result = run_project(tmp_path, files)
+        assert not unwaived(result, "retrace-hazard")
+
+    def test_self_method_resolution(self, tmp_path):
+        """self.method() chains resolve: a collective buried two methods
+        deep under a rank branch still fires."""
+        files = {
+            "pkg/__init__.py": "",
+            "pkg/harness.py": """
+                import jax
+
+                class Harness:
+                    def _barrier(self):
+                        from jax.experimental import multihost_utils
+                        multihost_utils.sync_global_devices("h")
+
+                    def _save(self):
+                        self._barrier()
+
+                    def finish(self):
+                        if jax.process_index() == 0:
+                            self._save()
+            """,
+        }
+        result = run_project(tmp_path, files)
+        hits = unwaived(result, "collective-order")
+        assert hits and any("_save" in (f.message or "") for f in hits)
+
+    def test_reexport_chain_resolution(self, tmp_path):
+        """Resolution follows package __init__ re-exports (the repo's
+        `from .parallel import is_primary` idiom)."""
+        files = {
+            "pkg/__init__.py": "",
+            "pkg/inner/__init__.py": """
+                from .impl import save_all  # noqa: F401
+            """,
+            "pkg/inner/impl.py": """
+                import jax
+
+                def save_all(tree):
+                    from jax.experimental import multihost_utils
+                    multihost_utils.sync_global_devices("s")
+            """,
+            "pkg/main.py": """
+                import jax
+                from .inner import save_all
+
+                def checkpoint(tree):
+                    if jax.process_index() == 0:
+                        save_all(tree)
+            """,
+        }
+        result = run_project(tmp_path, files)
+        assert unwaived(result, "collective-order")
+
+    def test_per_file_findings_not_duplicated(self, tmp_path):
+        """A site the lexical layer already flags yields exactly ONE
+        finding in project mode, not a per-file + interproc pair."""
+        files = {
+            "pkg/__init__.py": "",
+            "pkg/main.py": """
+                import jax
+
+                @jax.jit
+                def step(state):
+                    return state.sum().item()
+            """,
+        }
+        result = run_project(tmp_path, files)
+        hits = unwaived(result, "jit-host-sync")
+        assert len(hits) == 1
+
+    def test_project_text_report_shows_call_path(self, tmp_path):
+        bad, _ = INTERPROC_FIXTURES["jit-host-sync"]
+        proj = write_project(tmp_path, bad)
+        text = render_text(analyze_project([proj]))
+        assert "call path:" in text and "jit entry" in text
+
+
+# ----------------------------------------------------------- config rules
+
+SCHEMA_FIXTURE = """
+    from dataclasses import dataclass, field
+
+    METHODS = ("mag", "snip")
+
+
+    class ConfigError(ValueError):
+        pass
+
+
+    def _check_choice(name, value, choices):
+        if value not in choices:
+            raise ConfigError(name)
+
+
+    @dataclass
+    class TrainConfig:
+        lr: float = 0.1
+        steps: int = 10
+        method: str = "mag"
+        resume: bool = False
+        tag: str = ""
+
+        def validate(self):
+            _check_choice("train.method", self.method, METHODS)
+
+
+    @dataclass
+    class MainConfig:
+        train: TrainConfig = field(default_factory=TrainConfig)
+"""
+
+# consumer reads every TrainConfig field + the group itself, so the
+# dead-field rule stays quiet unless a fixture wants it to fire
+CONSUMER_FIXTURE = """
+    def use(cfg):
+        t = cfg.train
+        return (t.lr, t.steps, t.method, t.resume, t.tag)
+"""
+
+
+def conf_project(tmp_path, yamls: dict, schema=SCHEMA_FIXTURE, consumer=CONSUMER_FIXTURE):
+    files = {"proj_pkg/__init__.py": "", "proj_pkg/schema.py": schema,
+             "proj_pkg/consumer.py": consumer}
+    for rel, src in yamls.items():
+        files[f"conf/{rel}"] = src
+    return run_project(tmp_path, files)
+
+
+class TestConfRules:
+    def test_conf_rule_registry(self):
+        assert set(CONF_RULES) == {
+            "conf-duplicate-key",
+            "conf-unknown-key",
+            "conf-bad-choice",
+            "conf-type-mismatch",
+            "conf-missing-group-file",
+            "conf-dead-schema-field",
+        }
+        assert not (set(CONF_RULES) & set(RULES))
+
+    # -- each rule: catching fixture + non-catching twin ------------------
+
+    def test_unknown_key_caught(self, tmp_path):
+        r = conf_project(tmp_path, {"train/bad.yaml": "lrr: 0.5\n"})
+        (f,) = unwaived(r, "conf-unknown-key")
+        assert "lrr" in f.message and f.line == 1
+
+    def test_known_keys_silent(self, tmp_path):
+        r = conf_project(
+            tmp_path, {"train/good.yaml": "lr: 0.5\nsteps: 3\n"}
+        )
+        assert not unwaived(r, "conf-unknown-key")
+
+    def test_bad_choice_caught(self, tmp_path):
+        r = conf_project(tmp_path, {"train/bad.yaml": "method: bogus\n"})
+        (f,) = unwaived(r, "conf-bad-choice")
+        assert "bogus" in f.message and "mag" in f.message
+
+    def test_good_choice_silent(self, tmp_path):
+        r = conf_project(tmp_path, {"train/good.yaml": "method: snip\n"})
+        assert not unwaived(r, "conf-bad-choice")
+
+    def test_type_mismatch_caught(self, tmp_path):
+        r = conf_project(
+            tmp_path,
+            {"train/bad.yaml": "steps: plenty\nresume: maybe\nlr: [1]\n"},
+        )
+        msgs = [f.message for f in unwaived(r, "conf-type-mismatch")]
+        assert len(msgs) == 3
+        assert any("steps" in m for m in msgs)
+        assert any("resume" in m for m in msgs)
+        assert any("lr" in m for m in msgs)
+
+    def test_coercible_values_silent(self, tmp_path):
+        # YAML-1.1 gotchas _coerce handles: 5e-4 reads as str, "true" as
+        # str-bool, "5" as str-int — all coercible, none flagged
+        r = conf_project(
+            tmp_path,
+            {
+                "train/good.yaml": (
+                    'lr: 5e-4\nsteps: "5"\nresume: "true"\ntag: x\n'
+                )
+            },
+        )
+        assert not unwaived(r, "conf-type-mismatch")
+
+    def test_duplicate_key_caught(self, tmp_path):
+        r = conf_project(
+            tmp_path, {"train/bad.yaml": "lr: 0.1\nsteps: 2\nlr: 0.2\n"}
+        )
+        (f,) = unwaived(r, "conf-duplicate-key")
+        assert f.line == 3 and "line 1" in f.message
+
+    def test_unique_keys_silent(self, tmp_path):
+        r = conf_project(
+            tmp_path, {"train/good.yaml": "lr: 0.1\nsteps: 2\n"}
+        )
+        assert not unwaived(r, "conf-duplicate-key")
+
+    def test_missing_group_file_caught(self, tmp_path):
+        r = conf_project(
+            tmp_path,
+            {
+                "top.yaml": "defaults:\n  - _self_\n  - train: nope\n",
+                "train/good.yaml": "lr: 0.2\n",
+            },
+        )
+        (f,) = unwaived(r, "conf-missing-group-file")
+        assert "nope" in f.message
+
+    def test_present_group_file_silent(self, tmp_path):
+        r = conf_project(
+            tmp_path,
+            {
+                "top.yaml": "defaults:\n  - _self_\n  - train: good\n",
+                "train/good.yaml": "lr: 0.2\n",
+            },
+        )
+        assert not unwaived(r, "conf-missing-group-file")
+
+    def test_unknown_defaults_group_caught(self, tmp_path):
+        r = conf_project(
+            tmp_path,
+            {"top.yaml": "defaults:\n  - _self_\n  - evals: whatever\n"},
+        )
+        assert unwaived(r, "conf-unknown-key")
+
+    def test_toplevel_inline_group_values_checked(self, tmp_path):
+        r = conf_project(
+            tmp_path,
+            {"top.yaml": "train:\n  method: bogus\n  typo: 1\n"},
+        )
+        assert unwaived(r, "conf-bad-choice")
+        assert unwaived(r, "conf-unknown-key")
+
+    def test_dead_schema_field_caught(self, tmp_path):
+        consumer = """
+            def use(cfg):
+                t = cfg.train
+                return (t.lr, t.steps, t.method, t.resume)
+        """
+        r = conf_project(
+            tmp_path, {"train/good.yaml": "lr: 0.2\n"}, consumer=consumer
+        )
+        hits = unwaived(r, "conf-dead-schema-field")
+        assert ["tag" in f.message for f in hits] == [True]
+        assert "schema.py" in hits[0].file
+
+    def test_read_fields_silent(self, tmp_path):
+        r = conf_project(tmp_path, {"train/good.yaml": "lr: 0.2\n"})
+        assert not unwaived(r, "conf-dead-schema-field")
+
+    # -- yaml waivers -----------------------------------------------------
+
+    def test_yaml_inline_waiver(self, tmp_path):
+        r = conf_project(
+            tmp_path,
+            {
+                "train/w.yaml": (
+                    "method: bogus  "
+                    "# graftlint: disable=conf-bad-choice -- migration: "
+                    "option lands next PR\n"
+                )
+            },
+        )
+        assert not unwaived(r, "conf-bad-choice")
+        waived = [f for f in r.findings if f.waived]
+        assert waived and waived[0].waiver_reason.startswith("migration")
+        assert not r.unused_waivers
+
+    def test_yaml_standalone_waiver_covers_next_line(self, tmp_path):
+        r = conf_project(
+            tmp_path,
+            {
+                "train/w.yaml": (
+                    "# graftlint: disable=conf-bad-choice -- staged\n"
+                    "method: bogus\n"
+                )
+            },
+        )
+        assert not unwaived(r, "conf-bad-choice")
+
+    def test_stale_yaml_waiver_reported_in_project_mode(self, tmp_path):
+        r = conf_project(
+            tmp_path,
+            {
+                "train/w.yaml": (
+                    "method: snip  "
+                    "# graftlint: disable=conf-bad-choice -- obsolete\n"
+                )
+            },
+        )
+        assert r.unused_waivers
+
+    def test_conf_only_waiver_not_stale_per_file(self, tmp_path):
+        """A Python-side waiver naming only conf-* rules is out of scope
+        for per-file mode and must NOT be called stale there."""
+        p = tmp_path / "m.py"
+        p.write_text(
+            "X = 1  # graftlint: disable=conf-dead-schema-field -- project-scope\n"
+        )
+        result = analyze_paths([p])
+        assert not result.unused_waivers
+
+    # -- select / CLI integration ----------------------------------------
+
+    def test_select_narrows_conf_rules(self, tmp_path):
+        proj = write_project(
+            tmp_path,
+            {
+                "proj_pkg/__init__.py": "",
+                "proj_pkg/schema.py": SCHEMA_FIXTURE,
+                "proj_pkg/consumer.py": CONSUMER_FIXTURE,
+                "conf/train/bad.yaml": "method: bogus\ntypo: 1\n",
+            },
+        )
+        r = analyze_project([proj], select=["conf-bad-choice"])
+        assert unwaived(r, "conf-bad-choice")
+        assert not unwaived(r, "conf-unknown-key")
+
+    def test_cli_select_accepts_conf_rule(self, tmp_path, capsys):
+        proj = write_project(
+            tmp_path,
+            {
+                "proj_pkg/__init__.py": "",
+                "proj_pkg/schema.py": SCHEMA_FIXTURE,
+                "proj_pkg/consumer.py": CONSUMER_FIXTURE,
+                "conf/train/bad.yaml": "method: bogus\n",
+            },
+        )
+        rc = cli_main(
+            ["--project", "--select", "conf-bad-choice", str(proj)]
+        )
+        assert rc == 1
+        assert "conf-bad-choice" in capsys.readouterr().out
+
+    def test_cli_project_and_changed_mutually_exclusive(self, capsys):
+        assert cli_main(["--project", "--changed"]) == 2
+        capsys.readouterr()
+
+    def test_cli_changed_uses_git_diff(self, tmp_path, monkeypatch):
+        bad = tmp_path / "bad.py"
+        bad.write_text(textwrap.dedent(FIXTURES["broad-except"][0]))
+        import turboprune_tpu.analysis.cli as cli_mod
+
+        monkeypatch.setattr(
+            cli_mod, "_changed_python_files", lambda base: [str(bad)]
+        )
+        assert cli_mod.main(["--changed"]) == 1
+        monkeypatch.setattr(
+            cli_mod, "_changed_python_files", lambda base: []
+        )
+        assert cli_mod.main(["--changed"]) == 0

@@ -209,3 +209,67 @@ def test_fp16_precision_accepted():
         "cifar10_imp", overrides=["experiment_params.training_precision=float16"]
     )
     assert cfg.experiment_params.training_precision == "float16"
+
+
+# ------------------------------------------------- compose edge cases (PR 3)
+
+
+def test_duplicate_yaml_key_rejected(tmp_path):
+    """pyyaml silently keeps the LAST duplicate key; _load_yaml must refuse
+    instead — the clobbered value is config drift with no trace."""
+    (tmp_path / "dup.yaml").write_text(
+        "defaults:\n  - _self_\nseed: 1\nseed: 2\n"
+    )
+    with pytest.raises(ConfigError, match="duplicate config key 'seed'"):
+        compose_dict("dup", config_path=tmp_path)
+
+
+def test_duplicate_nested_yaml_key_rejected(tmp_path):
+    (tmp_path / "dup.yaml").write_text(
+        "experiment_params:\n  seed: 1\n  seed: 2\n"
+    )
+    with pytest.raises(ConfigError, match="duplicate config key 'seed'"):
+        compose_dict("dup", config_path=tmp_path)
+
+
+def test_dotted_override_unknown_group_rejected():
+    """A dotted override can invent a whole new top-level group; the schema
+    must reject it as an unknown MainConfig key, not absorb it."""
+    with pytest.raises(ConfigError, match="unknown config keys for MainConfig"):
+        compose("cifar10_imp", overrides=["bogus_group.lr=0.1"])
+
+
+def test_override_with_empty_value():
+    """``group.key=`` parses as the empty string: fine for str fields,
+    a loud coercion error (not a silent 0) for int fields."""
+    cfg = compose("cifar10_imp", overrides=["experiment_params.base_dir="])
+    assert cfg.experiment_params.base_dir == ""
+    with pytest.raises(ConfigError, match="cannot coerce seed=''"):
+        compose("cifar10_imp", overrides=["experiment_params.seed="])
+
+
+def test_non_mapping_group_file_rejected(tmp_path):
+    """A group option file containing a list (or scalar) must fail at load
+    with the offending path, not produce a half-merged config."""
+    import shutil
+
+    from turboprune_tpu.config import DEFAULT_CONFIG_PATH
+
+    conf = tmp_path / "conf"
+    shutil.copytree(DEFAULT_CONFIG_PATH, conf)
+    (conf / "model_params" / "broken.yaml").write_text("- a\n- b\n")
+    with pytest.raises(ConfigError, match="must contain a mapping"):
+        compose(
+            "cifar10_er_erk",
+            overrides=["model_params=broken"],
+            config_path=conf,
+        )
+
+
+def test_override_key_schema_rejects():
+    """Overriding a key that exists in no dataclass of the targeted group
+    dies with the group name in the message."""
+    with pytest.raises(
+        ConfigError, match="unknown config keys for ExperimentConfig"
+    ):
+        compose("cifar10_imp", overrides=["experiment_params.bogus=1"])

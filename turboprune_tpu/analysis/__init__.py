@@ -1,13 +1,26 @@
 """graftlint — JAX-aware static analysis for this repo.
 
-``python -m turboprune_tpu.analysis [paths]`` runs eight rules tuned to
-the failure modes that sink JAX/TPU training and serving stacks: host
-syncs inside jit, trace-cache-defeating jit construction, static_argnames
-typos, PRNG key reuse, rank-conditional collectives, donated-buffer
-reads, silent broad excepts, and debug output in compiled code. Findings
-are waived inline with ``# graftlint: disable=<rule> -- reason`` and the
-whole package is kept at zero unwaived findings by
-tests/test_analysis.py's self-gate.
+``python -m turboprune_tpu.analysis [paths]`` runs eight per-file rules
+tuned to the failure modes that sink JAX/TPU training and serving stacks:
+host syncs inside jit, trace-cache-defeating jit construction,
+static_argnames typos, PRNG key reuse, rank-conditional collectives,
+donated-buffer reads, silent broad excepts, and debug output in compiled
+code.
+
+``--project`` (PR 3) grows that into a whole-project analyzer: a symbol
+table + call graph (project.py, callgraph.py) lets five of those rules
+fire THROUGH call chains — the ``np.asarray`` three helpers below a
+jitted step, the collective buried under ``if is_primary():`` via a
+checkpoint wrapper, the key consumed twice through a sampler in another
+module — each finding carrying the call-path trace that justifies it.
+The same mode statically cross-checks every ``conf/**/*.yaml`` against
+the schema dataclasses (conf_rules.py): unknown keys, choice-set and
+type violations, broken ``defaults:`` entries, duplicate keys, and
+schema fields nothing ever reads.
+
+Findings are waived inline with ``# graftlint: disable=<rule> -- reason``
+(YAML comments included) and the whole package + conf is kept at zero
+unwaived findings by tests/test_analysis.py's self-gate.
 
 Deliberately jax-free: importing this package must work on any machine
 (pre-commit, CI sandboxes) without an accelerator stack. Importing
@@ -22,21 +35,25 @@ from .core import (  # noqa: F401
     Rule,
     Waiver,
     analyze_paths,
+    analyze_project,
     analyze_source,
     is_test_file,
     register,
 )
 from . import rules  # noqa: F401  (registers the rule set)
+from .conf_rules import CONF_RULES  # noqa: F401
 from .reporters import render_json, render_text  # noqa: F401
 
 __all__ = [
     "AnalysisResult",
+    "CONF_RULES",
     "Finding",
     "ModuleContext",
     "RULES",
     "Rule",
     "Waiver",
     "analyze_paths",
+    "analyze_project",
     "analyze_source",
     "is_test_file",
     "register",

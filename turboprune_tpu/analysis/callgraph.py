@@ -1,0 +1,479 @@
+"""Call graph + jit-reachability + interprocedural function summaries.
+
+Built on project.py's symbol table. Three products, all consumed by
+interproc.py:
+
+1. **Edges** — ``caller -> (callee, line)`` for every resolved call, plus
+   "passed as a callback" edges (a project function handed to another call
+   is assumed invokable there; over-approximate on purpose, reachability
+   wants no false negatives on resolved names).
+
+2. **Jit entries** — functions whose bodies end up traced. Lexical entries
+   come straight from regions.py; the interprocedural ones are the repo's
+   two factory idioms that the lexical layer documents as its blind spot:
+
+   * higher-order jitting — ``make_sharded_train_step(step, mesh)`` where
+     the factory's body does ``jax.jit(step, ...)``: the argument bound to
+     the jitted parameter becomes an entry;
+   * closure factories — ``raw = make_train_step(...)`` returns a nested
+     def, so when ``raw`` later flows into a jit (directly or via a
+     higher-order factory) the NESTED function is the entry, and its
+     callees (ops/masking, pruning/criteria, ...) become jit-reachable.
+
+3. **Summaries** — per-function facts the upgraded rules consume:
+   which params a function jits, whether it returns a nested def or a
+   donating jit, which key params it (transitively) consumes, whether it
+   (transitively) issues a collective, and whether it constructs a fresh
+   jit wrapper unconditionally on every call. Each summary memoizes and
+   carries a witness path so findings can print WHERE the sink is.
+
+Depth is bounded (``MAX_DEPTH``) and cycles short-circuit: the analysis
+must terminate on any input, including mutually recursive helpers.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Optional
+
+from .project import FunctionInfo, ModuleInfo, ProjectIndex
+from .regions import (
+    build_jit_regions,
+    donation_spec,
+    dotted_name,
+    is_jit_wrapper,
+    is_tracing_call,
+    unwrap_partial,
+)
+from .rules import (
+    _COLLECTIVE_TAILS,
+    _KEY_DERIVERS,
+    _is_jax_random,
+    _names_directly_under,
+    _own_statements,
+    _tail,
+    _walk_no_nested_defs,
+)
+
+__all__ = ["CallGraph", "MAX_DEPTH"]
+
+MAX_DEPTH = 10
+
+
+def _fmt(fi: FunctionInfo) -> str:
+    return f"{fi.name} ({fi.location()})"
+
+
+@dataclasses.dataclass
+class Reach:
+    """How a function becomes jit-traced: the entry plus the call chain."""
+
+    entry: FunctionInfo
+    entry_reason: str
+    path: tuple  # ((FunctionInfo, call line), ...) from entry to target
+
+    def trace(self) -> list:
+        hops = [f"jit entry {_fmt(self.entry)} [{self.entry_reason}]"]
+        hops.extend(f"{_fmt(fi)} called at line {line}" for fi, line in self.path)
+        return hops
+
+
+class CallGraph:
+    def __init__(self, index: ProjectIndex):
+        self.index = index
+        self.edges: dict = {}  # qualname -> [(FunctionInfo, line)]
+        self.jit_entries: dict = {}  # qualname -> reason
+        self.regions_by_module: dict = {}  # modname -> list[JitRegion]
+        self.reachable: dict = {}  # qualname -> Reach
+        self._memo: dict = {}
+        self._build()
+
+    # -------------------------------------------------------------- helpers
+    def _own_calls(self, fi: FunctionInfo):
+        for node in _walk_no_nested_defs(_own_statements(fi.node.body)):
+            if isinstance(node, ast.Call):
+                yield node
+
+    def _func_from_expr(
+        self,
+        expr: ast.AST,
+        mi: ModuleInfo,
+        scope: Optional[FunctionInfo],
+        local_fns: dict,
+    ) -> Optional[FunctionInfo]:
+        """A call argument that denotes a project function: a bare name, a
+        factory-result local, or partial(<one of those>, ...)."""
+        expr = unwrap_partial(expr)
+        if isinstance(expr, ast.Name):
+            if expr.id in local_fns:
+                return local_fns[expr.id]
+            return self.index.resolve_call(mi, expr, scope)
+        return None
+
+    def _scopes(self, mi: ModuleInfo):
+        """(scope FunctionInfo|None, statement list) for module + functions."""
+        yield None, mi.tree.body
+        for fi in self.index.functions.values():
+            if fi.modname == mi.modname and fi.path == mi.path:
+                yield fi, fi.node.body
+
+    def _local_fns(self, mi, scope, body) -> dict:
+        """name -> FunctionInfo for factory-result/alias locals in a scope.
+
+        ``raw = make_train_step(...)`` binds ``raw`` to the nested def the
+        factory returns; ``f = some_fn`` aliases. Order-insensitive (a map
+        over all assignments in the scope) — good enough for detection."""
+        out: dict = {}
+        for node in _walk_no_nested_defs(_own_statements(body)):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            target = node.targets[0]
+            if not isinstance(target, ast.Name):
+                continue
+            value = node.value
+            if isinstance(value, ast.Call):
+                callee = self.index.resolve_call(mi, value.func, scope)
+                if callee is not None:
+                    ret = self.returns_nested(callee)
+                    if ret is not None:
+                        out[target.id] = ret
+            elif isinstance(value, ast.Name):
+                fi = self.index.resolve_call(mi, value, scope)
+                if fi is not None:
+                    out[target.id] = fi
+        return out
+
+    # -------------------------------------------------------------- building
+    def _build(self) -> None:
+        for mi in self.index.modules.values():
+            self.regions_by_module[mi.modname] = build_jit_regions(mi.tree)
+
+        # lexical entries: regions whose node is an indexed function
+        for mi in self.index.modules.values():
+            for region in self.regions_by_module[mi.modname]:
+                fi = self.index.function_for_node(region.node)
+                if fi is not None:
+                    self.jit_entries.setdefault(fi.qualname, region.reason)
+
+        # edges
+        for fi in self.index.functions.values():
+            mi = self.index.modules.get(fi.modname)
+            if mi is None:
+                continue
+            edges = self.edges.setdefault(fi.qualname, [])
+            for call in self._own_calls(fi):
+                callee = self.index.resolve_call(mi, call.func, fi)
+                if callee is not None:
+                    edges.append((callee, call.lineno))
+                for arg in list(call.args) + [k.value for k in call.keywords]:
+                    passed = self._func_from_expr(arg, mi, fi, {})
+                    if passed is not None:
+                        edges.append((passed, call.lineno))
+
+        # higher-order entries: factory results + jitted params
+        for mi in self.index.modules.values():
+            for scope, body in self._scopes(mi):
+                self._detect_entries(mi, scope, body)
+
+        self._compute_reachability()
+
+    def _detect_entries(self, mi, scope, body) -> None:
+        local_fns = self._local_fns(mi, scope, body)
+        where = _fmt(scope) if scope else f"module scope ({mi.path})"
+        for call in _walk_no_nested_defs(_own_statements(body)):
+            if not isinstance(call, ast.Call):
+                continue
+            # direct: jax.jit(x) / lax.scan(x, ...) with x a tracked local
+            if is_jit_wrapper(call.func) or is_tracing_call(call.func):
+                for arg in call.args:
+                    fi = self._func_from_expr(arg, mi, scope, local_fns)
+                    if fi is not None:
+                        self.jit_entries.setdefault(
+                            fi.qualname,
+                            f"passed to {dotted_name(call.func)} at "
+                            f"{mi.path}:{call.lineno} in {where}",
+                        )
+                continue
+            # higher-order: callee jits one of its params
+            callee = self.index.resolve_call(mi, call.func, scope)
+            if callee is None:
+                continue
+            jitted = self.jits_params(callee)
+            if not jitted:
+                continue
+            bound = isinstance(call.func, ast.Attribute)
+            for param, arg in callee.arg_to_param(call, bound):
+                if param not in jitted:
+                    continue
+                fi = self._func_from_expr(arg, mi, scope, local_fns)
+                if fi is not None:
+                    self.jit_entries.setdefault(
+                        fi.qualname,
+                        f"jitted via {_fmt(callee)} (param {param!r}), "
+                        f"called at {mi.path}:{call.lineno} in {where}",
+                    )
+
+    def _compute_reachability(self) -> None:
+        frontier = []
+        for qual, reason in self.jit_entries.items():
+            fi = self.index.functions.get(qual)
+            if fi is None:
+                continue
+            self.reachable[qual] = Reach(entry=fi, entry_reason=reason, path=())
+            frontier.append(fi)
+        depth = 0
+        while frontier and depth < MAX_DEPTH:
+            depth += 1
+            nxt = []
+            for fi in frontier:
+                reach = self.reachable[fi.qualname]
+                for callee, line in self.edges.get(fi.qualname, ()):
+                    if callee.qualname in self.reachable:
+                        continue
+                    self.reachable[callee.qualname] = Reach(
+                        entry=reach.entry,
+                        entry_reason=reach.entry_reason,
+                        path=reach.path + ((callee, line),),
+                    )
+                    nxt.append(callee)
+            frontier = nxt
+
+    # ------------------------------------------------------------- summaries
+    def _memoized(self, key, compute, in_progress_value=None):
+        if key in self._memo:
+            return self._memo[key]
+        self._memo[key] = in_progress_value  # cycle guard
+        self._memo[key] = compute()
+        return self._memo[key]
+
+    def jits_params(self, fi: FunctionInfo) -> dict:
+        """Param names this function hands to a jit/tracing wrapper, with
+        the line it happens on: ``{param: line}``."""
+
+        def compute():
+            out = {}
+            params = set(fi.params)
+            for call in self._own_calls(fi):
+                if not (is_jit_wrapper(call.func) or is_tracing_call(call.func)):
+                    continue
+                if not call.args:
+                    continue
+                target = unwrap_partial(call.args[0])
+                if isinstance(target, ast.Name) and target.id in params:
+                    out.setdefault(target.id, call.lineno)
+            return out
+
+        return self._memoized(("jits", fi.qualname), compute, {})
+
+    def returns_nested(
+        self, fi: FunctionInfo, _depth: int = 0
+    ) -> Optional[FunctionInfo]:
+        """The nested def this function returns (closure-factory pattern)."""
+        if _depth > MAX_DEPTH:
+            return None
+
+        def compute():
+            mi = self.index.modules.get(fi.modname)
+            for node in _walk_no_nested_defs(_own_statements(fi.node.body)):
+                if not isinstance(node, ast.Return) or node.value is None:
+                    continue
+                value = unwrap_partial(node.value)
+                if isinstance(value, ast.Name):
+                    nested = self.index.functions.get(
+                        f"{fi.qualname}.{value.id}"
+                    )
+                    if nested is not None:
+                        return nested
+                elif isinstance(value, ast.Call) and mi is not None:
+                    callee = self.index.resolve_call(mi, value.func, fi)
+                    if callee is not None and callee.qualname != fi.qualname:
+                        inner = self.returns_nested(callee, _depth + 1)
+                        if inner is not None:
+                            return inner
+            return None
+
+        return self._memoized(("retnested", fi.qualname), compute)
+
+    def donating_factory(self, fi: FunctionInfo, _depth: int = 0):
+        """``(argnums, argnames, witness)`` when every call to this function
+        yields a freshly-built donating jit (mesh.py's make_sharded_*)."""
+        if _depth > MAX_DEPTH:
+            return None
+
+        def compute():
+            mi = self.index.modules.get(fi.modname)
+            for node in _walk_no_nested_defs(_own_statements(fi.node.body)):
+                if not isinstance(node, ast.Return) or node.value is None:
+                    continue
+                value = node.value
+                if isinstance(value, ast.Call):
+                    spec = donation_spec(value)
+                    if spec is not None:
+                        nums, names = spec
+                        return (
+                            nums,
+                            names,
+                            f"{_fmt(fi)} returns a donate_argnums jit "
+                            f"(line {value.lineno})",
+                        )
+                    if mi is not None:
+                        callee = self.index.resolve_call(mi, value.func, fi)
+                        if callee is not None and callee.qualname != fi.qualname:
+                            inner = self.donating_factory(callee, _depth + 1)
+                            if inner is not None:
+                                nums, names, witness = inner
+                                return (
+                                    nums,
+                                    names,
+                                    f"{_fmt(fi)} -> {witness}",
+                                )
+            return None
+
+        return self._memoized(("donates", fi.qualname), compute)
+
+    def collective_witness(self, fi: FunctionInfo, _depth: int = 0):
+        """Call-path to a collective this function (transitively) issues,
+        as a list of hop strings; None when it provably issues none we can
+        see. Uniform internal guards (process_count() == 1 early-outs) do
+        NOT clear it: ONE host calling this under a rank branch still posts
+        the collective that the other hosts never reach."""
+        if _depth > MAX_DEPTH:
+            return None
+
+        def compute():
+            mi = self.index.modules.get(fi.modname)
+            for call in self._own_calls(fi):
+                name = dotted_name(call.func)
+                if _tail(name) in _COLLECTIVE_TAILS:
+                    return [f"{name} ({fi.path}:{call.lineno})"]
+            if mi is None:
+                return None
+            for call in self._own_calls(fi):
+                callee = self.index.resolve_call(mi, call.func, fi)
+                if callee is None or callee.qualname == fi.qualname:
+                    continue
+                inner = self.collective_witness(callee, _depth + 1)
+                if inner is not None:
+                    return [f"{_fmt(callee)} called at line {call.lineno}"] + inner
+            return None
+
+        return self._memoized(("collective", fi.qualname), compute)
+
+    def key_consuming_params(self, fi: FunctionInfo, _depth: int = 0) -> dict:
+        """``{param: witness}`` for params whose key is (transitively)
+        consumed — handed to a jax.random sampler/split, directly or through
+        another project function. fold_in/clone-style DERIVATIONS don't
+        count (deriving is the sanctioned way to reuse a base key)."""
+        if _depth > MAX_DEPTH:
+            return {}
+
+        def compute():
+            out: dict = {}
+            params = set(fi.params)
+            mi = self.index.modules.get(fi.modname)
+            for call in self._own_calls(fi):
+                name = dotted_name(call.func)
+                if _is_jax_random(name):
+                    if _tail(name) in _KEY_DERIVERS:
+                        continue
+                    for used in _names_directly_under(call):
+                        if used in params and used not in out:
+                            out[used] = f"{name} ({fi.path}:{call.lineno})"
+                    continue
+                if mi is None:
+                    continue
+                callee = self.index.resolve_call(mi, call.func, fi)
+                if callee is None or callee.qualname == fi.qualname:
+                    continue
+                inner = self.key_consuming_params(callee, _depth + 1)
+                if not inner:
+                    continue
+                bound = isinstance(call.func, ast.Attribute)
+                for cparam, arg in callee.arg_to_param(call, bound):
+                    if cparam not in inner:
+                        continue
+                    for node in ast.walk(arg):
+                        if (
+                            isinstance(node, ast.Name)
+                            and node.id in params
+                            and node.id not in out
+                        ):
+                            out[node.id] = (
+                                f"{_fmt(callee)} called at line "
+                                f"{call.lineno} -> {inner[cparam]}"
+                            )
+            return out
+
+        return self._memoized(("keyparams", fi.qualname), compute, {})
+
+    def constructs_jit(self, fi: FunctionInfo, _depth: int = 0):
+        """``(line, witness)`` when EVERY call of this function builds a
+        fresh jit wrapper — i.e. the construction (or an unguarded call to
+        another constructor) sits outside any If/Try. A construction behind
+        a cache-miss guard (harness setup_level's ``if key not in cache:``)
+        is deliberate memoization and stays silent."""
+        if _depth > MAX_DEPTH:
+            return None
+
+        def compute():
+            mi = self.index.modules.get(fi.modname)
+
+            def earliest_return() -> int:
+                """Line of the first ``return`` in the body — an early
+                return BEFORE the jit construction means some calls skip
+                it (a cache lookup: serve/engine._executable), so 'every
+                call constructs' does not hold."""
+                lines = [
+                    n.lineno
+                    for n in _walk_no_nested_defs(
+                        _own_statements(fi.node.body)
+                    )
+                    if isinstance(n, ast.Return)
+                ]
+                return min(lines) if lines else 10**9
+
+            first_return = earliest_return()
+
+            def visit(node):
+                """First unguarded jit construction, pruning If/Try/IfExp
+                subtrees (guarded) and nested def/lambda scopes."""
+                if isinstance(
+                    node,
+                    (
+                        ast.If,
+                        ast.IfExp,
+                        ast.Try,
+                        ast.FunctionDef,
+                        ast.AsyncFunctionDef,
+                        ast.ClassDef,
+                        ast.Lambda,
+                    ),
+                ):
+                    return None
+                if isinstance(node, ast.Call):
+                    if is_jit_wrapper(node.func) and node.lineno <= first_return:
+                        return (
+                            node.lineno,
+                            f"{_fmt(fi)} builds {dotted_name(node.func)} "
+                            f"at line {node.lineno}",
+                        )
+                    if mi is not None and node.lineno <= first_return:
+                        callee = self.index.resolve_call(mi, node.func, fi)
+                        if callee is not None and callee.qualname != fi.qualname:
+                            inner = self.constructs_jit(callee, _depth + 1)
+                            if inner is not None:
+                                return (node.lineno, f"{_fmt(fi)} -> {inner[1]}")
+                for child in ast.iter_child_nodes(node):
+                    hit = visit(child)
+                    if hit is not None:
+                        return hit
+                return None
+
+            for stmt in fi.node.body:
+                hit = visit(stmt)
+                if hit is not None:
+                    return hit
+            return None
+
+        return self._memoized(("constructs", fi.qualname), compute)

@@ -3,7 +3,21 @@
 Exit codes (the contract scripts/check.sh and CI build on):
   0 — analyzed clean: zero unwaived findings
   1 — at least one unwaived finding
-  2 — usage / environment error (bad path, unknown rule in --select)
+  2 — usage / environment error (bad path, unknown rule in --select,
+      git unavailable for --changed)
+
+Three modes:
+
+* per-file (default) — the eight lexical rules over the given paths;
+* ``--project`` — per-file PLUS the interprocedural layer (symbol
+  table + call graph, rules fire through call chains with call-path
+  traces) PLUS the config rules over every ``*.yaml`` under the paths.
+  This is the pre-PR gate: ``--project turboprune_tpu conf tests``;
+* ``--changed [BASE]`` — per-file rules over only the ``.py`` files
+  changed vs BASE (default ``main``, via ``git diff --name-only`` plus
+  untracked files), so the fast half of the gate stays fast as the repo
+  grows. Project mode intentionally has no --changed variant: call
+  graphs and config cross-checks are whole-repo properties.
 
 With no paths it analyzes the installed ``turboprune_tpu`` package — the
 same invocation the self-gate test makes, so "the linter passes" means the
@@ -13,16 +27,27 @@ same thing locally, in CI, and in tests/test_analysis.py.
 from __future__ import annotations
 
 import argparse
+import subprocess
 import sys
 from pathlib import Path
 from typing import Optional, Sequence
 
-from .core import RULES, analyze_paths
+from .conf_rules import CONF_RULES
+from .core import RULES, analyze_paths, analyze_project
 from .reporters import render_json, render_text
 
 
 def _default_paths() -> list:
     return [str(Path(__file__).resolve().parents[1])]
+
+
+def _default_project_paths() -> list:
+    pkg = Path(__file__).resolve().parents[1]
+    paths = [str(pkg)]
+    conf = pkg.parent / "conf"
+    if conf.is_dir():
+        paths.append(str(conf))
+    return paths
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -31,13 +56,34 @@ def build_parser() -> argparse.ArgumentParser:
         description=(
             "graftlint: JAX-aware static analysis (host syncs in jit, "
             "retrace hazards, PRNG key reuse, rank-conditional "
-            "collectives, donated-buffer reads, swallowed exceptions)"
+            "collectives, donated-buffer reads, swallowed exceptions; "
+            "--project adds interprocedural call-chain analysis and "
+            "conf/ schema cross-checking)"
         ),
     )
     p.add_argument(
         "paths",
         nargs="*",
         help="files or directories (default: the turboprune_tpu package)",
+    )
+    p.add_argument(
+        "--project",
+        action="store_true",
+        help=(
+            "whole-project mode: interprocedural jit/RNG/collective "
+            "analysis over the call graph plus conf/*.yaml schema "
+            "cross-checks, on top of the per-file rules"
+        ),
+    )
+    p.add_argument(
+        "--changed",
+        nargs="?",
+        const="main",
+        metavar="BASE",
+        help=(
+            "lint only .py files changed vs BASE (default: main) per "
+            "git diff --name-only, plus untracked files"
+        ),
     )
     p.add_argument(
         "--json", action="store_true", help="machine-readable JSON report"
@@ -60,29 +106,92 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _changed_python_files(base: str) -> list:
+    """Changed-vs-base plus untracked .py files, as git reports them."""
+    files: list = []
+    for cmd in (
+        ["git", "diff", "--name-only", base, "--"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, check=True
+        )
+        files.extend(proc.stdout.splitlines())
+    out = []
+    seen = set()
+    for f in files:
+        if f.endswith(".py") and f not in seen and Path(f).exists():
+            seen.add(f)
+            out.append(f)
+    return out
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
 
+    all_rules = {**{r.id: r for r in RULES.values()}, **CONF_RULES}
     if args.list_rules:
-        width = max(len(r) for r in RULES)
+        width = max(len(r) for r in all_rules)
         for rule in RULES.values():
             print(f"{rule.id:<{width}}  [{rule.severity}] {rule.description}")
+        for rule in CONF_RULES.values():
+            print(
+                f"{rule.id:<{width}}  [{rule.severity}] [project] "
+                f"{rule.description}"
+            )
         return 0
+
+    if args.project and args.changed:
+        print(
+            "--project and --changed are mutually exclusive (the project "
+            "layer is a whole-repo property)",
+            file=sys.stderr,
+        )
+        return 2
 
     select = None
     if args.select:
         select = [r.strip() for r in args.select.split(",") if r.strip()]
-        unknown = [r for r in select if r not in RULES]
+        unknown = [r for r in select if r not in all_rules]
         if unknown:
             print(
                 f"unknown rule(s): {', '.join(unknown)} "
-                f"(known: {', '.join(sorted(RULES))})",
+                f"(known: {', '.join(sorted(all_rules))})",
                 file=sys.stderr,
             )
             return 2
 
     try:
-        result = analyze_paths(args.paths or _default_paths(), select=select)
+        if args.changed:
+            if args.paths:
+                print(
+                    "--changed takes no paths (it derives them from git)",
+                    file=sys.stderr,
+                )
+                return 2
+            try:
+                files = _changed_python_files(args.changed)
+            except (subprocess.CalledProcessError, OSError) as e:
+                detail = getattr(e, "stderr", "") or str(e)
+                print(
+                    f"graftlint --changed: git failed: {detail.strip()}",
+                    file=sys.stderr,
+                )
+                return 2
+            if not files:
+                print(
+                    f"graftlint: no .py files changed vs {args.changed}"
+                )
+                return 0
+            result = analyze_paths(files, select=select)
+        elif args.project:
+            result = analyze_project(
+                args.paths or _default_project_paths(), select=select
+            )
+        else:
+            result = analyze_paths(
+                args.paths or _default_paths(), select=select
+            )
     except (FileNotFoundError, OSError) as e:
         print(f"graftlint: {e}", file=sys.stderr)
         return 2

@@ -48,13 +48,19 @@ __all__ = [
     "AnalysisResult",
     "analyze_source",
     "analyze_paths",
+    "analyze_project",
     "is_test_file",
 ]
 
 
 @dataclasses.dataclass(frozen=True)
 class Finding:
-    """One rule violation at one source location."""
+    """One rule violation at one source location.
+
+    ``trace`` (project mode) is the call-path from a jit entry / consuming
+    helper / collective sink to the flagged site, one hop per string —
+    present so a reviewer can audit an interprocedural finding (or its
+    waiver) without re-deriving the chain by hand."""
 
     file: str
     line: int
@@ -64,6 +70,7 @@ class Finding:
     message: str
     waived: bool = False
     waiver_reason: Optional[str] = None
+    trace: Optional[list] = None
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -174,7 +181,11 @@ class ModuleContext:
         return self._regions
 
     def finding(
-        self, rule: "Rule", node: ast.AST, message: str
+        self,
+        rule: "Rule",
+        node: ast.AST,
+        message: str,
+        trace: Optional[list] = None,
     ) -> Finding:
         return Finding(
             file=self.path,
@@ -183,6 +194,7 @@ class ModuleContext:
             rule=rule.id,
             severity=rule.severity,
             message=message,
+            trace=trace,
         )
 
 
@@ -215,6 +227,9 @@ class AnalysisResult:
     findings: list  # every Finding, waived ones flagged
     waivers: list  # every Waiver, used ones flagged
     files_analyzed: int
+    # True for analyze_project results; per-file results leave it False so
+    # stale accounting can scope waivers to the rules the mode can fire.
+    project: bool = False
 
     @property
     def unwaived(self) -> list:
@@ -226,7 +241,18 @@ class AnalysisResult:
 
     @property
     def unused_waivers(self) -> list:
-        return [w for w in self.waivers if not w.used]
+        """Waivers that matched nothing. In per-file mode, waivers naming
+        only project-scope rules (the ``conf-*`` set) are out of scope —
+        they CANNOT match there and only project mode may call them stale
+        (which the project self-gate does)."""
+        unused = [w for w in self.waivers if not w.used]
+        if self.project:
+            return unused
+        return [
+            w
+            for w in unused
+            if not all(r.startswith("conf-") for r in w.rules)
+        ]
 
 
 def _apply_waivers(
@@ -252,6 +278,37 @@ def _apply_waivers(
     return out
 
 
+def _parse_error_finding(file: str, e: SyntaxError) -> Finding:
+    return Finding(
+        file=file,
+        line=e.lineno or 1,
+        col=(e.offset or 1) - 1,
+        rule="parse-error",
+        severity="error",
+        message=f"file does not parse: {e.msg}",
+    )
+
+
+def _run_rules_dedup(ctx: ModuleContext, select=None) -> list:
+    """Per-file rules over one parsed module, exact duplicates collapsed
+    (nested jit regions can surface the same node twice)."""
+    findings = []
+    for rule in RULES.values():
+        if select and rule.id not in select:
+            continue
+        if rule.skip_in_tests and ctx.is_test:
+            continue
+        findings.extend(rule.check(ctx))
+    seen: set = set()
+    unique = []
+    for f in sorted(findings, key=lambda f: (f.line, f.col, f.rule)):
+        key = (f.line, f.col, f.rule, f.message)
+        if key not in seen:
+            seen.add(key)
+            unique.append(f)
+    return unique
+
+
 def analyze_source(
     source: str,
     path="<string>",
@@ -264,35 +321,8 @@ def analyze_source(
     try:
         ctx = ModuleContext(file, source)
     except SyntaxError as e:
-        findings = [
-            Finding(
-                file=file,
-                line=e.lineno or 1,
-                col=(e.offset or 1) - 1,
-                rule="parse-error",
-                severity="error",
-                message=f"file does not parse: {e.msg}",
-            )
-        ]
-        return _apply_waivers(findings, waivers), waivers
-
-    findings = []
-    for rule in RULES.values():
-        if select and rule.id not in select:
-            continue
-        if rule.skip_in_tests and ctx.is_test:
-            continue
-        findings.extend(rule.check(ctx))
-    # Nested jit regions (a scan body inside a jitted def) can surface the
-    # same node twice — collapse exact duplicates.
-    seen: set = set()
-    unique = []
-    for f in sorted(findings, key=lambda f: (f.line, f.col, f.rule)):
-        key = (f.line, f.col, f.rule, f.message)
-        if key not in seen:
-            seen.add(key)
-            unique.append(f)
-    return _apply_waivers(unique, waivers), waivers
+        return _apply_waivers([_parse_error_finding(file, e)], waivers), waivers
+    return _apply_waivers(_run_rules_dedup(ctx, select), waivers), waivers
 
 
 def iter_python_files(paths: Iterable) -> list:
@@ -331,4 +361,134 @@ def analyze_paths(
         findings=all_findings,
         waivers=all_waivers,
         files_analyzed=len(files),
+    )
+
+
+# ----------------------------------------------------------- project mode
+
+
+def _yaml_root(file: Path, root: Path) -> Path:
+    """The conf root for one yaml: the passed directory, advanced through
+    a leading ``conf`` component so ``<repo>/conf/<group>/<option>.yaml``
+    resolves its group whether the caller passed the repo root or conf/
+    itself."""
+    try:
+        rel = file.relative_to(root)
+    except ValueError:
+        return root
+    while rel.parts and rel.parts[0] == "conf":
+        root = root / "conf"
+        rel = file.relative_to(root)
+    return root
+
+
+def _collect_project_files(paths) -> tuple:
+    """``(py_files, [(yaml_file, conf_root), ...])`` under ``paths``.
+
+    A directory contributes its ``.py`` tree to the symbol table and its
+    ``.yaml``/``.yml`` tree to the config rules. Overlapping paths dedupe
+    (deepest conf root wins, so group resolution stays correct)."""
+    py_files: list = []
+    yaml_roots: dict = {}
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            py_files.extend(
+                f
+                for f in sorted(p.rglob("*.py"))
+                if "__pycache__" not in f.parts
+            )
+            for pattern in ("*.yaml", "*.yml"):
+                for f in sorted(p.rglob(pattern)):
+                    root = _yaml_root(f, p)
+                    key = f.resolve()
+                    prior = yaml_roots.get(key)
+                    if prior is None or len(str(root)) > len(str(prior[1])):
+                        yaml_roots[key] = (f, root)
+        elif p.suffix == ".py":
+            py_files.append(p)
+        elif p.suffix in (".yaml", ".yml"):
+            yaml_roots.setdefault(p.resolve(), (p, p.parent))
+        else:
+            raise FileNotFoundError(
+                f"not a .py/.yaml file or directory: {p}"
+            )
+    seen_py: set = set()
+    unique_py: list = []
+    for f in py_files:
+        key = Path(f).resolve()
+        if key not in seen_py:
+            seen_py.add(key)
+            unique_py.append(f)
+    return unique_py, sorted(yaml_roots.values(), key=lambda t: str(t[0]))
+
+
+def _apply_waivers_by_file(findings: list, waivers: list) -> list:
+    by_file: dict = {}
+    for w in waivers:
+        by_file.setdefault(w.file, []).append(w)
+    grouped: dict = {}
+    for f in findings:
+        grouped.setdefault(f.file, []).append(f)
+    out: list = []
+    for file, fs in grouped.items():
+        out.extend(_apply_waivers(fs, by_file.get(file, [])))
+    return out
+
+
+def analyze_project(
+    paths: Iterable,
+    select: Optional[Sequence[str]] = None,
+) -> AnalysisResult:
+    """Whole-project mode: per-file rules PLUS the interprocedural layer
+    (symbol table + call graph; rules fire through call chains with a
+    call-path trace) PLUS the config static analysis over ``*.yaml`` files
+    against the schema dataclasses. Waivers come from Python comments and
+    from ``# graftlint: disable=...`` YAML comments alike; stale-waiver
+    accounting spans both layers (this is the mode the pre-PR gate runs)."""
+    from .conf_rules import analyze_conf
+    from .interproc import check_project
+    from .project import ProjectIndex
+
+    py_files, yaml_files = _collect_project_files(paths)
+    raw_findings: list = []
+    all_waivers: list = []
+    contexts: dict = {}
+    for f in py_files:
+        source = f.read_text(encoding="utf-8")
+        file = str(f)
+        all_waivers.extend(parse_waivers(source, file))
+        try:
+            ctx = ModuleContext(file, source)
+        except SyntaxError as e:
+            raw_findings.append(_parse_error_finding(file, e))
+            continue
+        contexts[file] = ctx
+        raw_findings.extend(_run_rules_dedup(ctx, select))
+
+    # interprocedural layer (dedup: a site already flagged per-file keeps
+    # its per-file finding; the interprocedural twin is dropped)
+    index = ProjectIndex.build(contexts.values())
+    seen = {(f.file, f.line, f.rule) for f in raw_findings}
+    for f in check_project(index, contexts):
+        if select and f.rule not in select:
+            continue
+        if (f.file, f.line, f.rule) not in seen:
+            seen.add((f.file, f.line, f.rule))
+            raw_findings.append(f)
+
+    # config rules
+    conf_findings, conf_waivers = analyze_conf(yaml_files, contexts)
+    raw_findings.extend(
+        f for f in conf_findings if not select or f.rule in select
+    )
+    all_waivers.extend(conf_waivers)
+
+    findings = _apply_waivers_by_file(raw_findings, all_waivers)
+    findings.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
+    return AnalysisResult(
+        findings=findings,
+        waivers=all_waivers,
+        files_analyzed=len(py_files) + len(yaml_files),
+        project=True,
     )

@@ -1,0 +1,302 @@
+"""Interprocedural checks: the per-file rules, fired through call chains.
+
+Five of the eight graftlint rules have failure modes that routinely live
+one or more calls away from the pattern the per-file layer matches:
+
+* ``jit-host-sync`` — the ``.item()``/``np.asarray`` sits in a helper
+  (ops/masking.py) called from a jitted step, not in the step itself;
+* ``collective-order`` — the call under ``if is_primary():`` is a benign-
+  looking wrapper (``save_pytree``) whose callee graph ends in
+  ``sync_global_devices``;
+* ``rng-key-reuse`` — the key is consumed twice via a sampler HELPER, so
+  no single scope ever hands it to jax.random twice;
+* ``donated-arg-reuse`` — the donating jit is built by a factory in
+  parallel/mesh.py, so the caller's scope never sees ``donate_argnums``;
+* ``retrace-hazard`` — the jit is constructed inside a factory that a
+  loop calls every iteration.
+
+Each finding reuses the per-file rule id (same waiver syntax, same
+``--select`` vocabulary) and carries a ``trace``: the call path from the
+jit entry / rank branch / donation site to the flagged line, so a waiver
+review can check the chain instead of trusting the tool. Findings that
+duplicate a per-file finding at the same (file, line, rule) are dropped
+by the driver — the lexical message is the more precise one.
+
+Resolution limits are inherited from project.py: unresolved calls are
+silent, resolved ones are exact. Depth bounds live in callgraph.py.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from .callgraph import CallGraph, _fmt
+from .core import RULES, is_test_file
+from .project import ProjectIndex
+from .regions import dotted_name
+from .rules import (
+    _COLLECTIVE_TAILS,
+    _own_statements,
+    _root,
+    _tail,
+    _walk_no_nested_defs,
+    JitHostSyncRule,
+    rank_conditional_test,
+    RngKeyReuseRule,
+    DonatedArgReuseRule,
+    RetraceHazardRule,
+)
+
+__all__ = ["check_project", "ProjectView"]
+
+
+class ProjectView:
+    """What the per-file dataflow rules may ask the project about."""
+
+    def __init__(self, graph: CallGraph, modinfo):
+        self.graph = graph
+        self.index = graph.index
+        self.mi = modinfo
+
+    def _scope_fi(self, scope_node):
+        if scope_node is None:
+            return None
+        return self.index.function_for_node(scope_node)
+
+    def rng_call_info(self, call: ast.Call, scope_node) -> Optional[list]:
+        """For a call resolved to a project function: ``[(arg_expr,
+        witness), ...]`` for the arguments bound to key-CONSUMING params
+        (possibly empty — a resolved non-consumer). None = unresolved."""
+        callee = self.index.resolve_call(self.mi, call.func, self._scope_fi(scope_node))
+        if callee is None:
+            return None
+        consuming = self.graph.key_consuming_params(callee)
+        bound = isinstance(call.func, ast.Attribute)
+        return [
+            (arg, f"{_fmt(callee)} -> {consuming[p]}")
+            for p, arg in callee.arg_to_param(call, bound)
+            if p in consuming
+        ]
+
+    def donating_spec(self, call: ast.Call, scope_node):
+        """(argnums, argnames, witness) when the call's callee is a
+        donating-jit factory; else None."""
+        callee = self.index.resolve_call(self.mi, call.func, self._scope_fi(scope_node))
+        if callee is None:
+            return None
+        return self.graph.donating_factory(callee)
+
+
+def _region_spans(graph: CallGraph, modname: str) -> list:
+    return [
+        (r.start, r.end) for r in graph.regions_by_module.get(modname, ())
+    ]
+
+
+def _in_spans(line: int, spans) -> bool:
+    return any(s <= line <= e for s, e in spans)
+
+
+def _host_sync_findings(graph: CallGraph, contexts) -> Iterator:
+    """Unconditional host syncs in functions that are jit-reachable but
+    not lexically marked (the lexical layer already covers those)."""
+    rule = JitHostSyncRule()
+    lexical_nodes = {
+        id(r.node)
+        for regions in graph.regions_by_module.values()
+        for r in regions
+    }
+    for qual, reach in graph.reachable.items():
+        fi = graph.index.functions.get(qual)
+        if fi is None or id(fi.node) in lexical_nodes:
+            continue
+        ctx = contexts.get(fi.path)
+        if ctx is None:
+            continue
+        spans = _region_spans(graph, fi.modname)
+        trace = reach.trace()
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            if _in_spans(node.lineno, spans):
+                continue  # lexically-traced sub-region; per-file covers it
+            f = node.func
+            name = dotted_name(f)
+            msg = None
+            if isinstance(f, ast.Attribute) and f.attr in rule._SYNC_METHODS:
+                msg = (
+                    f".{f.attr}() in {fi.name}(), which is jit-reachable — "
+                    "device->host sync inside compiled code; hoist it past "
+                    "the jit boundary"
+                )
+            elif _tail(name) == "device_get" and _root(name) in (
+                "jax",
+                "device_get",
+            ):
+                msg = (
+                    f"jax.device_get in jit-reachable {fi.name}() — host "
+                    "transfer in a compiled body; hoist it to the caller"
+                )
+            elif (
+                _root(name) in rule._NUMPY_ROOTS
+                and _tail(name) in rule._NUMPY_PULLS
+            ):
+                msg = (
+                    f"{name}(...) in jit-reachable {fi.name}() — numpy "
+                    "materializes on host; use jnp"
+                )
+            if msg:
+                yield ctx.finding(
+                    rule,
+                    node,
+                    msg,
+                    trace=trace + [f"{fi.name} ({fi.path}:{node.lineno})"],
+                )
+
+
+def _collective_findings(graph: CallGraph, contexts) -> Iterator:
+    """Calls under a rank-conditional branch whose callees (transitively)
+    issue a collective. Direct collective names under the branch are the
+    per-file rule's job and are skipped here."""
+    rule_obj = RULES["collective-order"]
+    index = graph.index
+    for mi in index.modules.values():
+        ctx = contexts.get(mi.path)
+        if ctx is None:
+            continue
+        scopes = [(None, mi.tree.body)]
+        scopes.extend(
+            (fi, fi.node.body)
+            for fi in index.functions.values()
+            if fi.path == mi.path
+        )
+        seen: set = set()
+        for scope, body in scopes:
+            for node in _walk_no_nested_defs(_own_statements(body)):
+                if not isinstance(node, ast.If) or not rank_conditional_test(node):
+                    continue
+                for branch in (node.body, node.orelse):
+                    for stmt in branch:
+                        for sub in ast.walk(stmt):
+                            if not isinstance(sub, ast.Call):
+                                continue
+                            if _tail(dotted_name(sub.func)) in _COLLECTIVE_TAILS:
+                                continue  # per-file rule's finding
+                            callee = index.resolve_call(mi, sub.func, scope)
+                            if callee is None:
+                                continue
+                            witness = graph.collective_witness(callee)
+                            if witness is None:
+                                continue
+                            key = (sub.lineno, sub.col_offset)
+                            if key in seen:
+                                continue
+                            seen.add(key)
+                            chain = [
+                                f"{_fmt(callee)} called at "
+                                f"{mi.path}:{sub.lineno}"
+                            ] + witness
+                            yield ctx.finding(
+                                rule_obj,
+                                sub,
+                                f"{dotted_name(sub.func)}(...) under a "
+                                "process_index()/is_primary() branch "
+                                "transitively issues a collective "
+                                f"({' -> '.join(witness)}) — hosts that "
+                                "skip the branch never post it and the pod "
+                                "deadlocks; run it on every host",
+                                trace=chain,
+                            )
+
+
+def _retrace_findings(graph: CallGraph, contexts) -> Iterator:
+    """Loop call sites of functions that build a fresh jit on every call
+    (cross-module factory-in-loop). Cache-guarded constructions are
+    already filtered out by the summary."""
+    rule = RetraceHazardRule()
+    index = graph.index
+    for mi in index.modules.values():
+        ctx = contexts.get(mi.path)
+        if ctx is None or ctx.is_test:
+            continue  # rule.skip_in_tests
+        scopes = [(None, mi.tree.body)]
+        scopes.extend(
+            (fi, fi.node.body)
+            for fi in index.functions.values()
+            if fi.path == mi.path
+        )
+        for scope, body in scopes:
+            yield from _retrace_scan(
+                rule, ctx, mi, index, graph, scope, _own_statements(body), 0
+            )
+
+
+def _retrace_scan(rule, ctx, mi, index, graph, scope, stmts, loops) -> Iterator:
+    for stmt in stmts:
+        in_loop = loops + (
+            1 if isinstance(stmt, (ast.For, ast.While, ast.AsyncFor)) else 0
+        )
+        if in_loop:
+            for node in _walk_no_nested_defs([stmt]):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = index.resolve_call(mi, node.func, scope)
+                if callee is None:
+                    continue
+                hit = graph.constructs_jit(callee)
+                if hit is None:
+                    continue
+                _line, witness = hit
+                yield ctx.finding(
+                    rule,
+                    node,
+                    f"{dotted_name(node.func)}(...) called in a loop "
+                    f"builds a fresh jit every iteration ({witness}) — "
+                    "hoist the factory call out of the loop or cache its "
+                    "result",
+                    trace=[witness],
+                )
+        else:
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, field, None)
+                if sub:
+                    yield from _retrace_scan(
+                        rule, ctx, mi, index, graph, scope,
+                        _own_statements(sub), loops,
+                    )
+            for h in getattr(stmt, "handlers", []) or []:
+                yield from _retrace_scan(
+                    rule, ctx, mi, index, graph, scope,
+                    _own_statements(h.body), loops,
+                )
+
+
+def check_project(index: ProjectIndex, contexts: dict) -> Iterator:
+    """All interprocedural findings over the indexed project.
+
+    ``contexts`` maps file path -> ModuleContext (the same parsed trees
+    the per-file pass used)."""
+    graph = CallGraph(index)
+
+    findings: list = list(_host_sync_findings(graph, contexts))
+    findings.extend(_collective_findings(graph, contexts))
+    findings.extend(_retrace_findings(graph, contexts))
+
+    # dataflow rules re-run with the project view (duplicates of the
+    # per-file pass are dropped by the caller)
+    rng = RngKeyReuseRule()
+    donated = DonatedArgReuseRule()
+    for mi in index.modules.values():
+        ctx = contexts.get(mi.path)
+        if ctx is None:
+            continue
+        view = ProjectView(graph, mi)
+        findings.extend(rng.check_project(ctx, view))
+        findings.extend(donated.check_project(ctx, view))
+
+    for f in findings:
+        rule = RULES.get(f.rule)
+        if rule is not None and rule.skip_in_tests and is_test_file(f.file):
+            continue
+        yield f

@@ -24,7 +24,15 @@ import ast
 import dataclasses
 from typing import Optional
 
-__all__ = ["JitRegion", "build_jit_regions", "dotted_name", "is_jit_wrapper"]
+__all__ = [
+    "JitRegion",
+    "build_jit_regions",
+    "donation_spec",
+    "dotted_name",
+    "is_jit_wrapper",
+    "is_tracing_call",
+    "unwrap_partial",
+]
 
 
 def dotted_name(node: ast.AST) -> Optional[str]:
@@ -89,7 +97,7 @@ def is_jit_wrapper(func: ast.AST) -> bool:
     )
 
 
-def _is_tracing_call(func: ast.AST) -> bool:
+def is_tracing_call(func: ast.AST) -> bool:
     name = dotted_name(func)
     if not name:
         return False
@@ -166,7 +174,7 @@ def _region_for_def(
     )
 
 
-def _unwrap_partial(node: ast.AST) -> ast.AST:
+def unwrap_partial(node: ast.AST) -> ast.AST:
     """partial(f, ...) -> f (one level is all the repo uses)."""
     if (
         isinstance(node, ast.Call)
@@ -175,6 +183,25 @@ def _unwrap_partial(node: ast.AST) -> ast.AST:
     ):
         return node.args[0]
     return node
+
+
+def donation_spec(call: ast.Call):
+    """``(argnums, argnames)`` from a jit-wrapper call carrying donation
+    keywords, or None. Shared by the per-file donated-arg-reuse rule and
+    the callgraph's donating-factory summary."""
+    if not isinstance(call, ast.Call) or not is_jit_wrapper(call.func):
+        return None
+    nums, names = [], []
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    nums.append(e.value)
+        elif kw.arg == "donate_argnames":
+            names.extend(literal_str_seq(kw.value) or [])
+    return (tuple(nums), tuple(names)) if (nums or names) else None
 
 
 def build_jit_regions(tree: ast.Module) -> list:
@@ -190,7 +217,7 @@ def build_jit_regions(tree: ast.Module) -> list:
         regions.setdefault((region.start, region.end), region)
 
     def add_callable(node: ast.AST, reason: str, static: list) -> None:
-        node = _unwrap_partial(node)
+        node = unwrap_partial(node)
         if isinstance(node, ast.Lambda):
             add(
                 JitRegion(
@@ -232,7 +259,7 @@ def build_jit_regions(tree: ast.Module) -> list:
                             )
                         )
         # -- function arguments to jit/shard_map/lax control flow
-        elif isinstance(node, ast.Call) and _is_tracing_call(node.func):
+        elif isinstance(node, ast.Call) and is_tracing_call(node.func):
             static = _static_names_from_call(node)
             reason = f"passed to {dotted_name(node.func)}"
             for arg in node.args:

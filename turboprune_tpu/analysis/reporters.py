@@ -19,7 +19,9 @@ from .core import AnalysisResult
 
 __all__ = ["render_text", "render_json"]
 
-JSON_SCHEMA_VERSION = 1
+# v2: findings gained "trace" (interprocedural call-path, null for
+# per-file findings) when --project mode landed.
+JSON_SCHEMA_VERSION = 2
 
 
 def render_text(result: AnalysisResult, show_waived: bool = False) -> str:
@@ -28,9 +30,12 @@ def render_text(result: AnalysisResult, show_waived: bool = False) -> str:
         if f.waived and not show_waived:
             continue
         tag = " (waived: %s)" % (f.waiver_reason or "no reason given") if f.waived else ""
+        trace = (
+            " [call path: %s]" % " -> ".join(f.trace) if f.trace else ""
+        )
         lines.append(
             f"{f.file}:{f.line}:{f.col + 1}: {f.rule} {f.severity}: "
-            f"{f.message}{tag}"
+            f"{f.message}{tag}{trace}"
         )
     for w in result.unused_waivers:
         lines.append(

@@ -19,6 +19,7 @@ from typing import Iterator, Optional
 
 from .core import ModuleContext, Rule, register
 from .regions import (
+    donation_spec,
     dotted_name,
     is_jit_wrapper,
     literal_str_seq,
@@ -109,12 +110,27 @@ def _traced_name_hits(expr: ast.AST, traced: frozenset) -> list:
 
 
 def _function_scopes(tree: ast.Module):
-    """(scope_body, param_names) for the module and each def — nested defs
-    are yielded separately and excluded from their parent's body walk."""
-    yield _own_statements(tree.body), []
+    """(scope_node, scope_body, param_names) for the module and each def —
+    nested defs are yielded separately and excluded from their parent's
+    body walk. scope_node is None for module scope (project mode uses it
+    to resolve ``self.m()`` and nested-def calls)."""
+    yield None, _own_statements(tree.body), []
     for node in ast.walk(tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            yield _own_statements(node.body), param_names(node)
+            yield node, _own_statements(node.body), param_names(node)
+
+
+def _names_in_arg(expr: ast.AST) -> list:
+    """Dotted names in one argument expression, excluding nested calls
+    (same attribution discipline as :func:`_names_directly_under`)."""
+    if isinstance(expr, ast.Call):
+        return []
+    out = []
+    for n in _walk_prune_calls(expr):
+        name = dotted_name(n)
+        if name and isinstance(n, (ast.Name, ast.Attribute)):
+            out.append(name)
+    return out
 
 
 def _own_statements(body):
@@ -470,12 +486,19 @@ class RngKeyReuseRule(Rule):
                     )
 
         # --- part B: per-scope double consumption
-        for body, params in _function_scopes(ctx.tree):
-            yield from self._check_scope(ctx, body, params)
+        for scope, body, params in _function_scopes(ctx.tree):
+            yield from self._check_scope(ctx, body, params, scope)
+
+    def check_project(self, ctx: ModuleContext, view) -> Iterator:
+        """Part B again, with the project view resolving helper calls to
+        their key-consumption summaries — ``draw(key); draw(key)`` fires
+        even when ``draw`` lives in another module."""
+        for scope, body, params in _function_scopes(ctx.tree):
+            yield from self._check_scope(ctx, body, params, scope, view)
 
     _KEYISH_PARAM = ("key", "rng", "prng")
 
-    def _check_scope(self, ctx, body, params) -> Iterator:
+    def _check_scope(self, ctx, body, params, scope=None, view=None) -> Iterator:
         findings: dict = {}  # (line, name) -> Finding
         uses: dict = {}  # key name -> first-use line (0 = unconsumed)
 
@@ -493,10 +516,17 @@ class RngKeyReuseRule(Rule):
             fed_to_jax_random: set = set()
             for stmt in body:
                 for sub in ast.walk(stmt):
-                    if isinstance(sub, ast.Call) and _is_jax_random(
-                        dotted_name(sub.func)
-                    ):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    if _is_jax_random(dotted_name(sub.func)):
                         fed_to_jax_random.update(_names_directly_under(sub))
+                    elif view is not None:
+                        # project mode: a keyish param handed to a resolved
+                        # key-CONSUMING helper is tracked too.
+                        info = view.rng_call_info(sub, scope)
+                        if info is not None:
+                            for arg, _witness in info:
+                                fed_to_jax_random.update(_names_in_arg(arg))
             for p in keyish:
                 if p in fed_to_jax_random:
                     uses[p] = 0
@@ -516,18 +546,20 @@ class RngKeyReuseRule(Rule):
             for name in _target_names(t):
                 uses[name] = 0  # tracked, unconsumed
 
-        def consume(name, node):
+        def consume(name, node, via=None):
             if name not in uses:
                 return
             if uses[name]:
                 key = (node.lineno, name)
                 if key not in findings:
+                    detail = f" (consumed via {via})" if via else ""
                     findings[key] = ctx.finding(
                         self,
                         node,
                         f"PRNG key {name!r} consumed again (first use "
                         f"line {uses[name]}) without an intervening "
-                        "split/fold_in — draws will be correlated",
+                        f"split/fold_in — draws will be correlated{detail}",
+                        trace=[via] if via else None,
                     )
             else:
                 uses[name] = node.lineno
@@ -542,6 +574,15 @@ class RngKeyReuseRule(Rule):
                 fname = dotted_name(sub.func)
                 if _is_jax_random(fname) and _tail(fname) in _KEY_DERIVERS:
                     continue
+                if view is not None and not _is_jax_random(fname):
+                    info = view.rng_call_info(sub, scope)
+                    if info is not None:
+                        # resolved project callee: charge exactly the args
+                        # bound to key-consuming params, nothing else
+                        for arg, witness in info:
+                            for name in set(_names_in_arg(arg)):
+                                consume(name, sub, via=witness)
+                        continue
                 for name in set(_names_directly_under(sub)):
                     consume(name, sub)
 
@@ -622,6 +663,40 @@ class RngKeyReuseRule(Rule):
 
 # --------------------------------------------------------- 5 collective-order
 
+# jax collectives + multihost utils + this repo's collective-bearing
+# wrappers (parallel/multihost.py). Module-level: the callgraph's
+# issues-a-collective summary keys off the same set.
+_COLLECTIVE_TAILS = {
+    "psum",
+    "pmean",
+    "pmax",
+    "pmin",
+    "all_gather",
+    "all_to_all",
+    "ppermute",
+    "pshuffle",
+    "psum_scatter",
+    "broadcast_one_to_all",
+    "process_allgather",
+    "sync_global_devices",
+    "assert_equal",
+    "broadcast_object",
+    "sync_hosts",
+    "check_state_equality",
+}
+
+# Rank-dependent truth sources: a branch on these is taken by SOME hosts.
+_RANK_SOURCES = {"process_index", "is_primary"}
+
+
+def rank_conditional_test(node: ast.If) -> bool:
+    """True when an ``if`` branches on process identity (not uniform
+    process_count()-style guards)."""
+    test_names = {
+        _tail(dotted_name(n)) for n in ast.walk(node.test) if dotted_name(n)
+    }
+    return bool(test_names & _RANK_SOURCES)
+
 
 @register
 class CollectiveOrderRule(Rule):
@@ -642,39 +717,14 @@ class CollectiveOrderRule(Rule):
         "branch — not all hosts reach it; multihost deadlock"
     )
 
-    # jax collectives + multihost utils + this repo's collective-bearing
-    # wrappers (parallel/multihost.py).
-    _COLLECTIVES = {
-        "psum",
-        "pmean",
-        "pmax",
-        "pmin",
-        "all_gather",
-        "all_to_all",
-        "ppermute",
-        "pshuffle",
-        "psum_scatter",
-        "broadcast_one_to_all",
-        "process_allgather",
-        "sync_global_devices",
-        "assert_equal",
-        "broadcast_object",
-        "sync_hosts",
-        "check_state_equality",
-    }
-    _RANK_SOURCES = {"process_index", "is_primary"}
+    _COLLECTIVES = _COLLECTIVE_TAILS
 
     def check(self, ctx: ModuleContext) -> Iterator:
         seen: set = set()
         for node in ast.walk(ctx.tree):
             if not isinstance(node, ast.If):
                 continue
-            test_names = {
-                _tail(dotted_name(n))
-                for n in ast.walk(node.test)
-                if dotted_name(n)
-            }
-            if not (test_names & self._RANK_SOURCES):
+            if not rank_conditional_test(node):
                 continue
             for branch in (node.body, node.orelse):
                 for stmt in branch:
@@ -721,47 +771,40 @@ class DonatedArgReuseRule(Rule):
     )
 
     def check(self, ctx: ModuleContext) -> Iterator:
-        for body, _params in _function_scopes(ctx.tree):
-            yield from self._check_scope(ctx, body)
+        for scope, body, _params in _function_scopes(ctx.tree):
+            yield from self._check_scope(ctx, body, scope)
+
+    def check_project(self, ctx: ModuleContext, view) -> Iterator:
+        """Scope dataflow again, with the project view recognising
+        donating FACTORIES from other modules: ``step = make_step(...)``
+        where make_step returns ``jax.jit(fn, donate_argnums=(0,))``
+        registers ``step`` as a donator here."""
+        for scope, body, _params in _function_scopes(ctx.tree):
+            yield from self._check_scope(ctx, body, scope, view)
 
     @staticmethod
     def _donation_spec(call: ast.Call):
-        """(argnums, argnames) from a jit-wrapper call, or None."""
-        if not is_jit_wrapper(call.func):
-            return None
-        nums, names = [], []
-        for kw in call.keywords:
-            if kw.arg == "donate_argnums":
-                v = kw.value
-                elts = (
-                    v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
-                )
-                for e in elts:
-                    if isinstance(e, ast.Constant) and isinstance(
-                        e.value, int
-                    ):
-                        nums.append(e.value)
-            elif kw.arg == "donate_argnames":
-                names.extend(literal_str_seq(kw.value) or [])
-        return (tuple(nums), tuple(names)) if (nums or names) else None
+        """(argnums, argnames, witness=None) from a jit-wrapper call."""
+        spec = donation_spec(call)
+        return spec + (None,) if spec is not None else None
 
-    def _check_scope(self, ctx, body) -> Iterator:
-        donators: dict = {}  # callable name -> (argnums, argnames)
-        dead: dict = {}  # donated var name -> donation line
+    def _check_scope(self, ctx, body, scope=None, view=None) -> Iterator:
+        donators: dict = {}  # callable name -> (argnums, argnames, witness)
+        dead: dict = {}  # donated var name -> (donation line, witness)
         findings: dict = {}
 
         def donate_from_call(call: ast.Call, spec) -> None:
-            nums, names = spec
+            nums, names, witness = spec
             for i in nums:
                 if i < len(call.args):
                     name = dotted_name(call.args[i])
                     if name:
-                        dead[name] = call.lineno
+                        dead[name] = (call.lineno, witness)
             for kw in call.keywords:
                 if kw.arg in names:
                     name = dotted_name(kw.value)
                     if name:
-                        dead[name] = call.lineno
+                        dead[name] = (call.lineno, witness)
 
         def flag_dead_reads(expr) -> None:
             for n in ast.walk(expr):
@@ -773,13 +816,16 @@ class DonatedArgReuseRule(Rule):
                 ):
                     key = (n.lineno, name)
                     if key not in findings:
+                        line, witness = dead[name]
+                        detail = f" (donating: {witness})" if witness else ""
                         findings[key] = ctx.finding(
                             self,
                             n,
                             f"{name!r} read after being donated at line "
-                            f"{dead[name]} — the buffer was handed to XLA "
+                            f"{line} — the buffer was handed to XLA "
                             "and may be deleted/aliased; rebind the jit's "
-                            "result instead",
+                            f"result instead{detail}",
+                            trace=[witness] if witness else None,
                         )
 
         def revive_target(t) -> None:
@@ -818,6 +864,14 @@ class DonatedArgReuseRule(Rule):
                             if isinstance(value, ast.Call)
                             else None
                         )
+                        if (
+                            spec is None
+                            and view is not None
+                            and isinstance(value, ast.Call)
+                        ):
+                            # step = make_step(...) with a cross-module
+                            # donating factory (witness names the jit site)
+                            spec = view.donating_spec(value, scope)
                         if spec is not None:
                             # g = jax.jit(f, donate_argnums=...)
                             for t in targets:

@@ -22,11 +22,41 @@ from .schema import ConfigError, MainConfig, config_from_dict
 DEFAULT_CONFIG_PATH = Path(__file__).resolve().parents[2] / "conf"
 
 
+class _StrictLoader(yaml.SafeLoader):
+    """SafeLoader that REJECTS duplicate mapping keys.
+
+    pyyaml's default quietly keeps the last occurrence — a config-drift
+    trap: the overridden value vanishes with no trace, and once the loser
+    key is gone not even static analysis can see it was ever there
+    (graftlint's conf-duplicate-key catches the file at rest; this catches
+    it at compose time, including configs loaded from outside conf/)."""
+
+    def construct_mapping(self, node, deep=False):
+        seen: dict = {}
+        for key_node, _value_node in node.value:
+            key = self.construct_object(key_node, deep=True)
+            try:
+                hash(key)
+            except TypeError:
+                continue  # unhashable: let the base constructor complain
+            line = key_node.start_mark.line + 1
+            if key in seen:
+                raise ConfigError(
+                    f"duplicate config key {key!r} (lines {seen[key]} and "
+                    f"{line}) — yaml would silently keep only the last value"
+                )
+            seen[key] = line
+        return super().construct_mapping(node, deep)
+
+
 def _load_yaml(path: Path) -> dict:
     if not path.exists():
         raise ConfigError(f"config file not found: {path}")
     with open(path) as f:
-        data = yaml.safe_load(f) or {}
+        try:
+            data = yaml.load(f, Loader=_StrictLoader) or {}
+        except ConfigError as e:
+            raise ConfigError(f"{path}: {e}") from e
     if not isinstance(data, dict):
         raise ConfigError(f"config file {path} must contain a mapping")
     return data

@@ -126,10 +126,16 @@ class DatasetConfig:
 @dataclass
 class ModelConfig:
     model_name: str = "resnet18"
+    # Reference-parity knob: masks are pytree-applied here (ops/masking.py)
+    # so the ConvMask/LinearMask wrapper distinction has no JAX analog; the
+    # key is accepted so reference configs compose, and validated so typos
+    # still fail.
+    # graftlint: disable=conf-dead-schema-field -- reference-parity: accepted+validated for config compatibility, structurally meaningless in the pytree-mask port
     mask_layer_type: str = "ConvMask"
     # Reference knob `use_compile` toggles torch.compile
     # (standard_pruning_harness.py:141); jit is unconditional here, the knob is
     # accepted for config compatibility and ignored.
+    # graftlint: disable=conf-dead-schema-field -- reference-parity: torch.compile toggle; jit is unconditional in the JAX port
     use_compile: bool = False
     # Local timm/DeiT torch checkpoint to warm-start ViT weights from
     # (reference deit.py:82-89 downloads these; no egress here, so the file
@@ -214,7 +220,10 @@ class ExperimentConfig:
     model_parallelism: int = 1
     # Cap on train/eval steps per epoch (0 = full epoch) — for smoke tests.
     max_steps_per_epoch: int = 0
-    log_every_steps: int = 50
+    # NOTE: the reference's log_every_steps knob is deliberately absent:
+    # the scan-epoch design has no per-step host loop to log from
+    # (metrics come back as per-epoch sums), so the knob could only ever
+    # be a silent no-op — graftlint's conf-dead-schema-field caught it.
     use_wandb: bool = False
     # When set, write a jax.profiler trace of level-0 epoch-1 here.
     profile_dir: str = ""
