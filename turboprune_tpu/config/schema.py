@@ -92,6 +92,16 @@ class DatasetConfig:
     tpk_val_path: str = ""
     tpk_auto_pack: bool = False
     tpk_nthreads: int = 0  # 0 = min(16, cpu_count)
+    # Streaming pipeline engine (grain/tpk; data/pipeline.py): bounded count
+    # of in-flight batches between decode and the consumer, and how many
+    # decode tasks run concurrently (tpk only — grain's stream is serial;
+    # its decode parallelism is num_workers worker processes).
+    prefetch_depth: int = 4
+    decode_workers: int = 2
+    # Streamed chunked-scan train path: fuse K prefetched batches into ONE
+    # compiled lax.scan dispatch (1 = per-step dispatch). Device-resident
+    # loaders already scan whole epochs and ignore this knob.
+    scan_chunk_steps: int = 1
 
     def validate(self) -> None:
         _check_choice("dataset_params.dataset_name", self.dataset_name, DATASETS)
@@ -115,6 +125,12 @@ class DatasetConfig:
             )
             if self.synthetic_snr <= 0:
                 raise ConfigError("synthetic_snr must be positive")
+        if self.prefetch_depth < 1:
+            raise ConfigError("prefetch_depth must be >= 1")
+        if self.decode_workers < 1:
+            raise ConfigError("decode_workers must be >= 1")
+        if self.scan_chunk_steps < 1:
+            raise ConfigError("scan_chunk_steps must be >= 1")
         if self.image_size == 0:
             self.image_size = 224 if self.dataset_name == "ImageNet" else 32
         if self.num_classes == 0:

@@ -38,6 +38,7 @@ from .augment import (
 )
 from .cifar import CifarLoaders, DeviceCifarLoader, cache_cifar_npz, load_cifar_arrays
 from .imagenet import GrainImageLoader, ImageFolderSource, ImageNetLoaders
+from .pipeline import PrefetchEngine, stream_batches
 from .synthetic import SyntheticLoaders, synthetic_arrays
 
 
@@ -76,6 +77,7 @@ def create_loaders(cfg) -> Any:
             num_workers=dp.num_workers,
             seed=seed,
             image_size=dp.image_size,
+            prefetch_depth=dp.prefetch_depth,
         )
     if dp.dataloader_type == "tpk":
         from .native import TpkLoaders
@@ -87,6 +89,8 @@ def create_loaders(cfg) -> Any:
             image_size=dp.image_size,
             seed=seed,
             nthreads=dp.tpk_nthreads,
+            prefetch_depth=dp.prefetch_depth,
+            decode_workers=dp.decode_workers,
             train_path=dp.tpk_train_path,
             val_path=dp.tpk_val_path,
             auto_pack=dp.tpk_auto_pack,
@@ -106,4 +110,6 @@ __all__ = [
     "cache_cifar_npz",
     "synthetic_arrays",
     "augment_epoch",
+    "PrefetchEngine",
+    "stream_batches",
 ]

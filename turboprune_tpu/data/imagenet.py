@@ -26,7 +26,6 @@ from pathlib import Path
 from typing import Iterator, Optional
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from .augment import IMAGENET_MEAN, IMAGENET_STD
@@ -198,7 +197,7 @@ class GrainImageLoader:
         train: bool,
         num_workers: int = 16,
         seed: int = 0,
-        prefetch: int = 2,
+        prefetch_depth: int = 4,
         image_size: int = IMAGE_SIZE,
     ):
         if not HAS_GRAIN:  # pragma: no cover
@@ -214,9 +213,10 @@ class GrainImageLoader:
         self.train = train
         self.num_workers = num_workers
         self.seed = seed
-        self.prefetch = prefetch
+        self.prefetch_depth = prefetch_depth
         self.image_size = image_size
         self.epoch = 0
+        self.last_pipeline_stats: Optional[dict] = None
         self._stream: Optional[Iterator] = None  # persistent sample/batch stream
         shard = grain.ShardByJaxProcess(drop_remainder=train)
         self._shard_count = shard.shard_count
@@ -342,22 +342,65 @@ class GrainImageLoader:
                 )
                 count += 1
 
-    def __iter__(self) -> Iterator[tuple[jax.Array, jax.Array]]:
-        """Yield device-resident (normalized images, labels), keeping
-        ``prefetch`` batches in flight (async dispatch makes device_put +
-        normalize overlap the previous step's compute)."""
+    def _epoch_tasks(self, max_batches: Optional[int] = None):
+        """(decode-task iterator, n) for one epoch's worth of pulls off the
+        persistent grain stream. The grain iterator is NOT random-access, so
+        the pipeline engine must run these tasks serially (workers=1) — the
+        actual decode parallelism lives in grain's ``num_workers`` worker
+        PROCESSES behind the stream; the engine's job here is overlapping
+        the pull + device transfer with consumer compute."""
         self.epoch += 1
-        queue: list[tuple[jax.Array, jax.Array]] = []
-        for images, labels in self._raw_batches():
-            queue.append(
-                (
-                    _normalize_device(jnp.asarray(images)),
-                    jnp.asarray(labels, jnp.int32),
-                )
-            )
-            if len(queue) > self.prefetch:
-                yield queue.pop(0)
-        yield from queue
+        n = len(self)
+        if max_batches is not None:
+            n = min(n, max_batches)
+        raw = self._raw_batches()
+
+        def tasks():
+            for _ in range(n):
+                yield raw.__next__
+
+        return tasks(), n
+
+    def _set_stats(self, stats: dict) -> None:
+        self.last_pipeline_stats = stats
+
+    def __iter__(self) -> Iterator[tuple[jax.Array, jax.Array]]:
+        """Device batches for one epoch through the shared prefetch engine
+        (data/pipeline.py): a bounded ring of in-flight batches between the
+        grain stream and the consumer, with per-stage wall-time stats in
+        ``last_pipeline_stats`` after each epoch."""
+        from .pipeline import stream_batches
+
+        task_iter, n = self._epoch_tasks()
+        if n == 0:
+            return
+        yield from stream_batches(
+            task_iter,
+            depth=self.prefetch_depth,
+            workers=1,  # serial stream: order IS the grain iterator order
+            name="grain",
+            stats_sink=self._set_stats,
+        )
+
+    def iter_chunks(
+        self, chunk: int, max_batches: Optional[int] = None
+    ) -> Iterator[tuple[jax.Array, jax.Array]]:
+        """Chunked epoch for the scan-chunk train path (same contract as
+        TpkImageLoader.iter_chunks): stacked [K, B, ...] device chunks,
+        with a short tail emitted as plain per-step batches."""
+        from .pipeline import stream_batches
+
+        task_iter, n = self._epoch_tasks(max_batches)
+        if n == 0:
+            return
+        yield from stream_batches(
+            task_iter,
+            depth=max(self.prefetch_depth, chunk),
+            workers=1,
+            chunk=chunk,
+            name="grain",
+            stats_sink=self._set_stats,
+        )
 
 
 class ImageNetLoaders:
@@ -370,6 +413,7 @@ class ImageNetLoaders:
         num_workers: int = 16,
         seed: int = 0,
         image_size: int = IMAGE_SIZE,
+        prefetch_depth: int = 4,
     ):
         root = Path(data_root_dir)
         self.train_loader = GrainImageLoader(
@@ -379,6 +423,7 @@ class ImageNetLoaders:
             num_workers=num_workers,
             seed=seed,
             image_size=image_size,
+            prefetch_depth=prefetch_depth,
         )
         self.test_loader = GrainImageLoader(
             str(root / "val"),
@@ -387,6 +432,7 @@ class ImageNetLoaders:
             num_workers=num_workers,
             seed=seed,
             image_size=image_size,
+            prefetch_depth=prefetch_depth,
         )
         if self.train_loader.source.classes != self.test_loader.source.classes:
             raise ValueError(

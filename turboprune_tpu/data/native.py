@@ -25,7 +25,6 @@ from pathlib import Path
 from typing import Iterator, Optional, Sequence
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from .padding import pad_eval_batch
@@ -159,8 +158,12 @@ class TpkFile:
             pass
 
     def read_raw(
-        self, indices: np.ndarray, nthreads: int = 4
+        self, indices: np.ndarray, nthreads: int = 0
     ) -> tuple[np.ndarray, np.ndarray]:
+        """``nthreads=0`` = auto (min(16, cpu_count)); loaders pass the
+        configured ``dataset_params.tpk_nthreads`` through instead of
+        relying on a hardcoded default."""
+        nthreads = _resolve_nthreads(nthreads)
         indices = np.ascontiguousarray(indices, np.int64)
         n = len(indices)
         images = np.empty((n, self.height, self.width, self.channels), np.uint8)
@@ -184,8 +187,9 @@ class TpkFile:
         train: bool,
         seed: int = 0,
         center_crop_ratio: float = 224 / 256,
-        nthreads: int = 4,
+        nthreads: int = 0,
     ) -> tuple[np.ndarray, np.ndarray]:
+        nthreads = _resolve_nthreads(nthreads)
         indices = np.ascontiguousarray(indices, np.int64)
         n = len(indices)
         images = np.empty((n, out_size, out_size, 3), np.uint8)
@@ -205,6 +209,10 @@ class TpkFile:
         if rc:
             raise RuntimeError(f"tpk_decode_batch failed (rc={rc})")
         return images, labels
+
+
+def _resolve_nthreads(nthreads: int) -> int:
+    return nthreads or min(16, os.cpu_count() or 1)
 
 
 def make_shard(n: int, pid: int, nproc: int) -> np.ndarray:
@@ -247,6 +255,8 @@ class TpkImageLoader:
         image_size: int = 224,
         seed: int = 0,
         nthreads: int = 0,
+        prefetch_depth: int = 4,
+        decode_workers: int = 2,
     ):
         self.file = TpkFile(path)
         nproc = jax.process_count()
@@ -256,8 +266,11 @@ class TpkImageLoader:
         self.train = train
         self.image_size = image_size
         self.seed = seed
-        self.nthreads = nthreads or min(16, os.cpu_count() or 1)
+        self.nthreads = _resolve_nthreads(nthreads)
+        self.prefetch_depth = prefetch_depth
+        self.decode_workers = decode_workers
         self.epoch = 0
+        self.last_pipeline_stats: Optional[dict] = None
         self._nproc = nproc
         self._shard = make_shard(self.file.num_samples, jax.process_index(), nproc)
 
@@ -287,15 +300,11 @@ class TpkImageLoader:
             images, labels = pad_eval_batch(images, labels, self.batch_size)
         return images, labels
 
-    def __iter__(self) -> Iterator[tuple[jax.Array, jax.Array]]:
-        """Decode batch b+1 on a background thread while batch b is on
-        device (FFCV's pipelined-decode architecture): the C++ decode
-        releases the GIL inside its worker threads, so host decode overlaps
-        the accelerator step dispatched between ``next()`` calls."""
-        from concurrent.futures import ThreadPoolExecutor
-
-        from .imagenet import _normalize_device
-
+    def _epoch_tasks(self, max_batches: Optional[int] = None):
+        """(decode-task iterator, n) for one epoch; advances the epoch
+        counter (the per-epoch shuffle/augment PRNG stream) exactly like the
+        pre-engine iterator did — on first consumption, since callers wrap
+        this in a generator."""
         epoch = self.epoch
         self.epoch += 1
         order = self._shard
@@ -303,15 +312,59 @@ class TpkImageLoader:
             rng = np.random.default_rng(self.seed + epoch)
             order = rng.permutation(order)
         n = len(self)
+        if max_batches is not None:
+            n = min(n, max_batches)
+
+        def tasks():
+            from functools import partial
+
+            for b in range(n):
+                yield partial(self._decode_batch, order, b, epoch)
+
+        return tasks(), n
+
+    def _set_stats(self, stats: dict) -> None:
+        self.last_pipeline_stats = stats
+
+    def __iter__(self) -> Iterator[tuple[jax.Array, jax.Array]]:
+        """Device batches for one epoch through the shared prefetch engine
+        (data/pipeline.py): ``decode_workers`` concurrent C++ decode calls
+        (each ``nthreads``-threaded, GIL released) feed a transfer stage, so
+        decode, H2D transfer and device compute all overlap — FFCV's
+        pipelined-decode architecture, shared with the grain loader."""
+        from .pipeline import stream_batches
+
+        task_iter, n = self._epoch_tasks()
         if n == 0:
             return
-        with ThreadPoolExecutor(max_workers=1) as pool:
-            pending = pool.submit(self._decode_batch, order, 0, epoch)
-            for b in range(n):
-                images, labels = pending.result()
-                if b + 1 < n:
-                    pending = pool.submit(self._decode_batch, order, b + 1, epoch)
-                yield _normalize_device(jnp.asarray(images)), jnp.asarray(labels)
+        yield from stream_batches(
+            task_iter,
+            depth=self.prefetch_depth,
+            workers=self.decode_workers,
+            name="tpk",
+            stats_sink=self._set_stats,
+        )
+
+    def iter_chunks(
+        self, chunk: int, max_batches: Optional[int] = None
+    ) -> Iterator[tuple[jax.Array, jax.Array]]:
+        """Chunked epoch for the scan-chunk train path: yields stacked
+        [K, B, ...] device chunks (K = ``chunk``); a tail of fewer than K
+        batches comes out as plain [B, ...] batches so the consumer sees at
+        most two shapes (one scan program + one per-step program)."""
+        from .pipeline import stream_batches
+
+        task_iter, n = self._epoch_tasks(max_batches)
+        if n == 0:
+            return
+        yield from stream_batches(
+            task_iter,
+            depth=max(self.prefetch_depth, chunk),
+            workers=self.decode_workers,
+            chunk=chunk,
+            name="tpk",
+            stats_sink=self._set_stats,
+        )
 
 
 class TpkLoaders:
@@ -330,6 +383,8 @@ class TpkLoaders:
         image_size: int = 224,
         seed: int = 0,
         nthreads: int = 0,
+        prefetch_depth: int = 4,
+        decode_workers: int = 2,
         train_path: str = "",
         val_path: str = "",
         auto_pack: bool = False,
@@ -354,6 +409,8 @@ class TpkLoaders:
             image_size=image_size,
             seed=seed,
             nthreads=nthreads,
+            prefetch_depth=prefetch_depth,
+            decode_workers=decode_workers,
         )
         self.test_loader = TpkImageLoader(
             val_tpk,
@@ -362,6 +419,8 @@ class TpkLoaders:
             image_size=image_size,
             seed=seed,
             nthreads=nthreads,
+            prefetch_depth=prefetch_depth,
+            decode_workers=decode_workers,
         )
         self.num_classes = num_classes
 

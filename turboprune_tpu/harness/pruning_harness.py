@@ -39,10 +39,12 @@ from ..models import create_model
 from ..ops import masking
 from ..parallel import (
     assemble_batch,
+    assemble_chunk,
     create_mesh,
     is_primary,
     epoch_sharding,
     make_sharded_eval_step,
+    make_sharded_scan_chunk,
     make_sharded_scan_epoch,
     make_sharded_scan_eval,
     make_sharded_train_step,
@@ -55,6 +57,7 @@ from ..train import (
     create_train_state,
     eval_params,
     make_eval_step,
+    make_scan_chunk,
     make_scan_epoch,
     make_scan_eval,
     make_train_step,
@@ -200,8 +203,9 @@ class PruningHarness:
             raw_step = make_train_step(self.model, tx, schedule)
             step = make_sharded_train_step(raw_step, self.mesh)
             scan = make_sharded_scan_epoch(make_scan_epoch(raw_step), self.mesh)
-            self._step_cache[total_steps] = (tx, schedule, step, scan)
-        self.tx, self.schedule, self._train_step, self._scan_epoch = (
+            chunk = make_sharded_scan_chunk(make_scan_chunk(raw_step), self.mesh)
+            self._step_cache[total_steps] = (tx, schedule, step, scan, chunk)
+        self.tx, self.schedule, self._train_step, self._scan_epoch, self._scan_chunk = (
             self._step_cache[total_steps]
         )
         self.state = replicate(
@@ -241,7 +245,9 @@ class PruningHarness:
         Fast path: device-resident loaders expose ``epoch_arrays`` and the
         whole epoch runs as ONE lax.scan program (make_scan_epoch) — no
         per-step host dispatch at all. Streaming loaders (grain/tpk) take
-        the per-batch path."""
+        the chunked-scan path when ``dataset_params.scan_chunk_steps > 1``
+        (K batches per compiled dispatch) and the per-batch path
+        otherwise."""
         if (
             hasattr(self.loaders.train_loader, "epoch_arrays")
             and not self.cfg.experiment_params.max_steps_per_epoch
@@ -266,13 +272,32 @@ class PruningHarness:
         t0 = time.perf_counter()
         train_loader = self.loaders.train_loader
         train_scope = getattr(train_loader, "batch_scope", "global")
-        for i, batch in enumerate(train_loader):
-            if i >= self.steps_per_epoch:
-                break
-            batch = assemble_batch(batch, self.mesh, train_scope)
-            self.state, m = self._train_step(self.state, batch)
-            m = {k: v for k, v in m.items() if k != "lr"}
-            sums = m if sums is None else jax.tree.map(jnp.add, sums, m)
+        chunk_steps = self.cfg.dataset_params.scan_chunk_steps
+        if chunk_steps > 1 and hasattr(train_loader, "iter_chunks"):
+            # Chunked-scan streamed path: the pipeline engine stacks K
+            # prefetched batches ([K, B, ...]) and each full chunk runs as
+            # ONE compiled lax.scan dispatch while the engine refills
+            # behind it; a sub-K tail (epoch length % K) arrives as plain
+            # per-step batches so only two executables ever compile.
+            for batch in train_loader.iter_chunks(
+                chunk_steps, max_batches=self.steps_per_epoch
+            ):
+                if batch[0].ndim == 5:
+                    cb = assemble_chunk(batch, self.mesh, train_scope)
+                    self.state, m = self._scan_chunk(self.state, cb)
+                else:
+                    b = assemble_batch(batch, self.mesh, train_scope)
+                    self.state, m = self._train_step(self.state, b)
+                    m = {k: v for k, v in m.items() if k != "lr"}
+                sums = m if sums is None else jax.tree.map(jnp.add, sums, m)
+        else:
+            for i, batch in enumerate(train_loader):
+                if i >= self.steps_per_epoch:
+                    break
+                batch = assemble_batch(batch, self.mesh, train_scope)
+                self.state, m = self._train_step(self.state, batch)
+                m = {k: v for k, v in m.items() if k != "lr"}
+                sums = m if sums is None else jax.tree.map(jnp.add, sums, m)
         if sums is None:
             raise RuntimeError(
                 "train loader yielded no batches — dataset smaller than "

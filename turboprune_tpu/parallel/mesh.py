@@ -146,3 +146,31 @@ def make_sharded_scan_epoch(
         out_shardings=(replicated(mesh), replicated(mesh)),
         donate_argnums=(0,) if donate_state else (),
     )
+
+
+def make_sharded_scan_chunk(
+    scan_chunk: Callable, mesh: Mesh, donate_state: bool = True
+) -> Callable:
+    """jit the chunked-scan runner (train/steps.py make_scan_chunk) for the
+    STREAMED train path: K stacked prefetched batches [K, B, ...] execute
+    as one XLA program (state replicated + donated, batch axis sharded on
+    ``data``) — the same compilation contract as the whole-epoch scan, at
+    chunk granularity so data that doesn't fit in HBM still amortizes
+    dispatch."""
+    return make_sharded_scan_epoch(scan_chunk, mesh, donate_state)
+
+
+def assemble_chunk(batch: PyTree, mesh: Mesh, scope: str = "global") -> PyTree:
+    """``assemble_batch`` for a STACKED chunk [K, B, ...]: place with the
+    step axis replicated and the batch axis (dim 1) sharded on ``data``
+    (epoch_sharding). Host-scope chunks ([K, local_B, ...] per host) are
+    assembled with ``jax.make_array_from_process_local_data`` like their
+    per-batch counterpart."""
+    sharding = epoch_sharding(mesh)
+    if scope == "global" or jax.process_count() == 1:
+        return jax.device_put(batch, sharding)
+    if scope != "host":
+        raise ValueError(f"unknown batch scope {scope!r}")
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(sharding, x), batch
+    )

@@ -10,8 +10,8 @@ from .schedules import (
     triangular_schedule,
 )
 from .state import TrainState, create_train_state, init_variables, reset_optimizer
-from .steps import (cross_entropy_sum, make_eval_step, make_scan_epoch,
-                    make_scan_eval,
+from .steps import (cross_entropy_sum, make_eval_step, make_scan_chunk,
+                    make_scan_epoch, make_scan_eval,
                     make_train_step)
 
 __all__ = [
@@ -30,6 +30,7 @@ __all__ = [
     "imagenet_lr_drops_warmup",
     "onecycle_schedule",
     "make_train_step",
+    "make_scan_chunk",
     "make_scan_epoch",
     "make_scan_eval",
     "make_eval_step",

@@ -99,23 +99,25 @@ def make_train_step(
     return train_step
 
 
-def make_scan_epoch(
+def make_scan_chunk(
     train_step: Callable[[TrainState, Batch], tuple[TrainState, dict]],
 ) -> Callable[[TrainState, Batch], tuple[TrainState, dict]]:
-    """Fold a whole sequence of steps into ONE compiled program.
+    """Fold a stacked sequence of K train steps into ONE compiled program.
 
-    ``batches`` is the epoch stacked on a leading step axis:
-    (images [S, B, H, W, C], labels [S, B]). ``lax.scan`` runs the step S
-    times inside a single XLA executable — zero per-step host dispatch,
-    which matters doubly here: device-resident CIFAR epochs already live in
-    HBM (data/cifar.py), and every host->device dispatch pays fixed latency
-    (the reference pays Python-loop + DDP launch overhead per step instead,
-    base_harness.py:174). Returned metrics are summed over steps.
+    ``batches`` is K steps stacked on a leading axis: (images
+    [K, B, H, W, C], labels [K, B]). ``lax.scan`` runs the step K times
+    inside a single XLA executable, collapsing K host dispatches (each
+    paying fixed launch latency) into one. Returned metrics are summed over
+    the K steps (``lr`` dropped — it is per-step, not summable).
 
-    No reference equivalent — this is only possible because the whole
-    pipeline (augmentation included) is on-device."""
+    This is the CIFAR zero-dispatch trick generalized to data that does NOT
+    fit in HBM: the streamed harness path stacks K prefetched batches from
+    the pipeline engine (data/pipeline.py) and scans them while the engine
+    refills behind the running program. K is
+    ``dataset_params.scan_chunk_steps``; an epoch is the K = full-epoch
+    special case (make_scan_epoch)."""
 
-    def scan_epoch(state: TrainState, batches: Batch) -> tuple[TrainState, dict]:
+    def scan_chunk(state: TrainState, batches: Batch) -> tuple[TrainState, dict]:
         def body(s, batch):
             s, m = train_step(s, batch)
             return s, m
@@ -126,7 +128,21 @@ def make_scan_epoch(
         }
         return state, sums
 
-    return scan_epoch
+    return scan_chunk
+
+
+def make_scan_epoch(
+    train_step: Callable[[TrainState, Batch], tuple[TrainState, dict]],
+) -> Callable[[TrainState, Batch], tuple[TrainState, dict]]:
+    """Whole epoch as ONE compiled program: the K = steps-per-epoch case of
+    ``make_scan_chunk``, for device-resident loaders whose full epoch is
+    already stacked in HBM (data/cifar.py ``epoch_arrays``) — zero per-step
+    host dispatch (the reference pays Python-loop + DDP launch overhead per
+    step instead, base_harness.py:174).
+
+    No reference equivalent — only possible because the whole pipeline
+    (augmentation included) is on-device."""
+    return make_scan_chunk(train_step)
 
 
 def make_scan_eval(
