@@ -88,6 +88,90 @@ class TestMidLevelSlot:
         assert ckpts.peek_mid_level() is None  # no JSONDecodeError escape
 
 
+class TestPackedMasks:
+    """ISSUE-5 satellite: mask payloads are bit-packed (uint8 bitfields +
+    shape metadata) in model checkpoints — 8x smaller — and legacy
+    checkpoints with raw bool masks still load."""
+
+    def test_pack_roundtrip_and_size(self, small_state):
+        from turboprune_tpu.utils import pack_mask_tree, unpack_mask_tree
+
+        _, _, state = small_state
+        masks = masking.mask_where(
+            state.masks, lambda m: jnp.asarray(np.random.default_rng(0).random(m.shape) < 0.5)
+        )
+        packed = pack_mask_tree(masks)
+        bits = sum(
+            int(leaf["bits"].size)
+            for leaf in jax.tree.leaves(
+                packed, is_leaf=lambda x: isinstance(x, dict) and "bits" in x
+            )
+            if isinstance(leaf, dict)
+        )
+        total = sum(int(m.size) for m in masking.mask_leaves(masks))
+        assert bits <= total // 8 + len(masking.mask_leaves(masks))  # ~8x
+        back = unpack_mask_tree(packed)
+        for a, b in zip(
+            masking.mask_leaves(masks), masking.mask_leaves(back)
+        ):
+            assert np.asarray(b).dtype == np.bool_
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_model_checkpoint_roundtrip_is_packed(self, small_state, tmp_path):
+        from turboprune_tpu.utils.checkpoint import _has_packed_masks
+
+        _, _, state = small_state
+        pruned = state.replace(
+            masks=masking.mask_where(
+                state.masks,
+                lambda m: jnp.asarray(
+                    np.random.default_rng(1).random(m.shape) < 0.3
+                ),
+            )
+        )
+        ck = ExperimentCheckpoints(tmp_path)
+        ck.save_model("model_init", pruned)
+        assert _has_packed_masks(ck.model_path("model_init").resolve())
+        back = ck.load_model("model_init", pruned)
+        for a, b in zip(
+            masking.mask_leaves(pruned.masks), masking.mask_leaves(back["masks"])
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(
+            np.asarray(_first_param(back["params"])),
+            np.asarray(_first_param(pruned.params)),
+        )
+
+    def test_legacy_unpacked_checkpoint_still_loads(self, small_state, tmp_path):
+        """A checkpoint written BEFORE the packing change (raw bool mask
+        leaves) must restore through the same load path."""
+        _, _, state = small_state
+        ck = ExperimentCheckpoints(tmp_path)
+        # Legacy writer: raw model_state tree, no packing.
+        save_pytree(ck.model_path("model_init"), ck.model_state(state))
+        back = ck.load_model("model_init", state)
+        assert set(back) == {"params", "masks", "batch_stats"}
+        for a, b in zip(
+            masking.mask_leaves(state.masks), masking.mask_leaves(back["masks"])
+        ):
+            assert np.asarray(b).dtype == np.bool_
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_mid_level_slot_packs_masks_too(self, small_state, tmp_path):
+        from turboprune_tpu.utils.checkpoint import _has_packed_masks
+
+        _, _, state = small_state
+        ck = ExperimentCheckpoints(tmp_path)
+        ck.save_mid_level(1, 2, state, meta={})
+        assert _has_packed_masks(ck.mid_level_path().resolve())
+        got = ck.load_mid_level(state, expect_level=1, expect_epoch=2)
+        assert got is not None
+        for a, b in zip(
+            masking.mask_leaves(state.masks), masking.mask_leaves(got["masks"])
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 class TestPytreeRoundTrip:
     def test_masks_none_leaves_and_bool_dtype_survive(self, small_state, tmp_path):
         _, _, state = small_state

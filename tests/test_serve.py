@@ -90,7 +90,7 @@ def _reference_forward(expt_dir: str, images: np.ndarray) -> np.ndarray:
     from turboprune_tpu.train.state import init_variables
     from turboprune_tpu.utils.checkpoint import (
         ExperimentCheckpoints,
-        restore_pytree,
+        restore_model_tree,
     )
 
     cfg = config_from_dict(
@@ -110,7 +110,7 @@ def _reference_forward(expt_dir: str, images: np.ndarray) -> np.ndarray:
     )
     ckpts = ExperimentCheckpoints(expt_dir)
     level = ckpts.saved_levels()[-1]
-    restored = restore_pytree(
+    restored = restore_model_tree(
         ckpts.level_path(level),
         {
             "params": variables["params"],
@@ -178,6 +178,31 @@ class TestEngine:
         # Steady state: every request hit a warm bucket — zero new traces.
         assert metrics.counter("compile_cache_misses_total") == misses_before
         assert metrics.counter("compile_cache_hits_total") >= hits_before + 10
+
+    def test_compact_load_path_matches_dense_engine(self, expt, engine):
+        """serve.compact: the engine slices dead channels, AOT-compiles the
+        smaller model, and serves logits equivalent to the mask-folded
+        path (identical here: this mag-pruned checkpoint has scattered
+        zeros, no dead fan-out slices, so compaction is the identity —
+        which the report must say honestly)."""
+        _, expt_dir = expt
+        metrics = ServeMetrics()
+        eng = InferenceEngine.from_experiment(
+            expt_dir, buckets=(4,), metrics=metrics, compact=True
+        )
+        assert eng.density < 1.0
+        info = eng.info()["compaction"]
+        assert info["params_after"] <= info["params_before"]
+        assert metrics.snapshot()["compaction_params_compacted"] == info[
+            "params_after"
+        ]
+        rng = np.random.default_rng(7)
+        images = rng.standard_normal((4, 32, 32, 3)).astype(np.float32)
+        got = eng.predict(images)
+        want = engine.predict(images)
+        # Identity compaction -> same program modulo recompilation; bound
+        # covers fp reassociation for the general (sliced) case too.
+        np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
 
     def test_role_checkpoint_and_bad_shapes(self, expt):
         _, expt_dir = expt

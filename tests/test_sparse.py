@@ -1,0 +1,407 @@
+"""Dead-channel compaction tests (turboprune_tpu/sparse/).
+
+ISSUE-5 acceptance: the compacted forward is numerically equivalent to the
+masked-dense forward. Exact contract (sparse/compact.py docstring): masks
+fold exactly, only channels with (all-zero fan-out AND exactly-zero
+post-activation residue) are sliced, and what remains is the same
+arithmetic with zero terms removed — so differences are pure XLA
+reassociation noise. Tolerances here reflect that: fp32 CNN logits agree to
+~1e-5 absolute (measured ~3e-8 on this host); the ER-ERK cases additionally
+assert the documented bound.
+
+Coverage: ResNet + VGG at ER-ERK ~90% sparsity (satellite), with channel
+kills layered on top (pure ER-ERK at conv shapes almost never produces a
+fully dead fan-out slice — P(all 9*C_in zeros) ~ (1-d)^(9*C_in)); the
+no-dead-channels identity case; the all-dead-layer refusal; DenseNet
+(concat offsets) and ViT (MLP hidden) parity; residue blocking (a dead
+channel whose relu(bn(0)) constant is nonzero must be KEPT); harness
+compact_eval parity; serve-engine compact path; and top_k-vs-sort
+threshold bit-identity (ops/masking.py satellite).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from turboprune_tpu.models import create_model
+from turboprune_tpu.models.densenet import DenseNet
+from turboprune_tpu.models.vgg import VGG
+from turboprune_tpu.models.vit import VisionTransformer
+from turboprune_tpu.ops import masking
+from turboprune_tpu.pruning.criteria import prune_er_erk
+from turboprune_tpu.sparse import (
+    CompactionError,
+    build_graph,
+    compact_params,
+)
+
+# Measured reassociation noise on fp32 CNN logits is ~3e-8 (this host);
+# 1e-5 gives ample headroom without hiding semantic bugs (those are O(1)).
+ATOL = 1e-5
+
+
+def _mutable_masks(masks):
+    return jax.tree.map(
+        lambda m: None if m is None else np.array(m),
+        masks,
+        is_leaf=lambda x: x is None,
+    )
+
+
+def _kill_channels(masks, graph, frac, spaces=None):
+    """Zero the first ``frac`` of each space's fan-out slices — the channel
+    structure compaction exists to exploit."""
+    out = _mutable_masks(masks)
+    for name, sp in graph.spaces.items():
+        if spaces is not None and name not in spaces:
+            continue
+        node = out
+        for k in sp.producer.kernel[:-1]:
+            node = node[k]
+        m = node[sp.producer.kernel[-1]]
+        m[..., : int(m.shape[-1] * frac)] = False
+    return out
+
+
+def _logits(model, variables, x):
+    return np.asarray(
+        jax.device_get(jax.jit(lambda xx: model.apply(variables, xx, train=False))(x)),
+        np.float32,
+    )
+
+
+def _dense_vs_compacted(model, small_ctor, params, stats, masks, x):
+    graph = build_graph(model, params)
+    res = compact_params(params, masks, graph, stats)
+    var_d = {"params": masking.apply_masks(params, masks)}
+    var_s = {"params": res.params}
+    if stats:
+        var_d["batch_stats"] = stats
+        var_s["batch_stats"] = res.batch_stats
+    small = small_ctor(res.width_overrides)
+    return _logits(model, var_d, x), _logits(small, var_s, x), res
+
+
+@pytest.fixture(scope="module")
+def resnet_setup():
+    model = create_model("resnet18", 10, "CIFAR10", compute_dtype=jnp.float32)
+    v = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=False)
+    return model, v["params"], v["batch_stats"]
+
+
+class TestResNetCompaction:
+    def test_er_erk_90_with_dead_channels_parity(self, resnet_setup):
+        """The satellite case: ER-ERK ~90% sparsity, plus killed channels so
+        there is real structure to harvest; compacted logits match
+        masked-dense within the documented reassociation tolerance."""
+        model, params, stats = resnet_setup
+        masks = prune_er_erk(
+            params, masking.make_masks(params), 0.1, jax.random.PRNGKey(1)
+        )
+        graph = build_graph(model, params)
+        masks = _kill_channels(masks, graph, 0.5)
+        assert masking.overall_sparsity(masks) > 90.0
+        x = np.random.default_rng(0).standard_normal((4, 32, 32, 3)).astype(
+            np.float32
+        )
+        dense, compacted, res = _dense_vs_compacted(
+            model,
+            lambda ov: create_model(
+                "resnet18", 10, "CIFAR10", compute_dtype=jnp.float32,
+                width_overrides=ov,
+            ),
+            params, stats, masks, x,
+        )
+        np.testing.assert_allclose(compacted, dense, atol=ATOL, rtol=1e-5)
+        # Real shrinkage: half of every block-internal axis died.
+        assert res.report["params_after"] < res.report["params_before"]
+        assert res.report["channels_after"] == res.report["channels_before"] // 2
+        assert len(res.width_overrides) == len(graph.spaces)
+
+    def test_no_dead_channels_is_identity(self, resnet_setup):
+        """ER-ERK alone: scattered zeros, no dead fan-out slices — the
+        compacted model has identical shapes (and bit-identical folded
+        weights; only the mask multiply got folded)."""
+        model, params, stats = resnet_setup
+        masks = prune_er_erk(
+            params, masking.make_masks(params), 0.1, jax.random.PRNGKey(1)
+        )
+        graph = build_graph(model, params)
+        res = compact_params(params, masks, graph, stats)
+        assert res.width_overrides == {}
+        assert res.report["params_after"] == res.report["params_before"]
+        folded = masking.apply_masks(params, masks)
+        for a, b in zip(jax.tree.leaves(folded), jax.tree.leaves(res.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_all_dead_layer_refused(self, resnet_setup):
+        model, params, stats = resnet_setup
+        graph = build_graph(model, params)
+        masks = _kill_channels(
+            masking.make_masks(params), graph, 1.0,
+            spaces={"layer1_0/Conv_0"},
+        )
+        with pytest.raises(CompactionError, match="all .* channels are dead"):
+            compact_params(params, masks, graph, stats)
+
+    def test_nonzero_residue_blocks_removal(self, resnet_setup):
+        """A dead conv channel still emits relu(bn(0)); when the BN bias
+        makes that constant positive, slicing the channel would change
+        consumer outputs — it must be KEPT (and counted) instead, keeping
+        the parity contract unconditional."""
+        model, params, stats = resnet_setup
+        graph = build_graph(model, params)
+        masks = _kill_channels(
+            masking.make_masks(params), graph, 0.25, spaces={"layer2_0/Conv_0"}
+        )
+        # Nonzero BN bias on the dead channels -> relu(bn(0)) > 0.
+        params = jax.tree.map(np.asarray, params)
+        bn = params["layer2_0"]["BatchNorm_0"]["bias"]
+        n_dead = int(bn.shape[0] * 0.25)
+        bn = np.array(bn)
+        bn[:n_dead] = 1.0
+        params["layer2_0"]["BatchNorm_0"]["bias"] = bn
+        res = compact_params(params, masks, graph, stats)
+        rep = res.report["spaces"]["layer2_0/Conv_0"]
+        assert rep["dead"] == n_dead
+        assert rep["blocked_residue"] == n_dead
+        assert rep["kept"] == rep["channels"]  # nothing sliced
+        x = np.random.default_rng(1).standard_normal((2, 32, 32, 3)).astype(
+            np.float32
+        )
+        small = create_model(
+            "resnet18", 10, "CIFAR10", compute_dtype=jnp.float32,
+            width_overrides=res.width_overrides,
+        )
+        dense = _logits(
+            model,
+            {"params": masking.apply_masks(params, masks), "batch_stats": stats},
+            x,
+        )
+        compacted = _logits(
+            small, {"params": res.params, "batch_stats": res.batch_stats}, x
+        )
+        np.testing.assert_allclose(compacted, dense, atol=ATOL, rtol=1e-5)
+
+
+# Small VGG instance (VGG class + registry-identical topology rules): full
+# vgg16_bn at 32px carries a 118M-param classifier — pointlessly slow for a
+# parity test on this 1-core container; cfg still exercises 5 pool stages,
+# the BN gate chain, and the 7x7-flatten (repeat=49) consumer edge.
+VGG_CFG = [16, "M", 32, "M", 32, 32, "M", 64, 64, "M", 64, 64, "M"]
+
+
+def _vgg(batch_norm, ov=None):
+    return VGG(
+        VGG_CFG, 10, batch_norm=batch_norm, fc_features=(96, 96),
+        width_overrides=tuple(sorted(ov.items())) if ov else None,
+    )
+
+
+class TestVGGCompaction:
+    @pytest.mark.parametrize("batch_norm", [True, False])
+    def test_er_erk_90_with_dead_channels_parity(self, batch_norm):
+        model = _vgg(batch_norm)
+        v = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=False
+        )
+        params, stats = v["params"], v.get("batch_stats", {})
+        masks = prune_er_erk(
+            params, masking.make_masks(params), 0.1, jax.random.PRNGKey(2)
+        )
+        graph = build_graph(model, params)
+        # fc spaces are in the graph too — kill there as well to cover the
+        # dense->dense and conv->flatten(49x)->dense edges.
+        masks = _kill_channels(masks, graph, 0.5)
+        assert masking.overall_sparsity(masks) > 90.0
+        x = np.random.default_rng(3).standard_normal((4, 32, 32, 3)).astype(
+            np.float32
+        )
+        dense, compacted, res = _dense_vs_compacted(
+            model, lambda ov: _vgg(batch_norm, ov), params, stats, masks, x
+        )
+        np.testing.assert_allclose(compacted, dense, atol=ATOL, rtol=1e-5)
+        assert res.report["params_after"] < res.report["params_before"]
+        # The flatten consumer sliced fc0's in-axis by 49 x conv-keep.
+        fc0_in = np.asarray(res.params["fc0"]["kernel"]).shape[0]
+        last_conv_kept = res.report["spaces"][
+            max(s for s in res.report["spaces"] if s.startswith("conv"))
+        ]["kept"]
+        assert fc0_in == 49 * last_conv_kept
+
+
+class TestDenseNetViTCompaction:
+    def test_densenet_concat_offsets_parity(self):
+        model = DenseNet(
+            [2, 3], 10, growth_rate=8, init_features=16, cifar_stem=True
+        )
+        v = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=False
+        )
+        params, stats = v["params"], v["batch_stats"]
+        graph = build_graph(model, params)
+        masks = _kill_channels(masking.make_masks(params), graph, 0.5)
+        x = np.random.default_rng(4).standard_normal((2, 32, 32, 3)).astype(
+            np.float32
+        )
+        dense, compacted, res = _dense_vs_compacted(
+            model,
+            lambda ov: DenseNet(
+                [2, 3], 10, growth_rate=8, init_features=16, cifar_stem=True,
+                width_overrides=tuple(sorted(ov.items())),
+            ),
+            params, stats, masks, x,
+        )
+        np.testing.assert_allclose(compacted, dense, atol=ATOL, rtol=1e-5)
+        # Every segment (stem, growths, transition) halved.
+        assert res.report["channels_after"] == res.report["channels_before"] // 2
+
+    def test_vit_mlp_hidden_parity(self):
+        model = VisionTransformer(
+            num_classes=10, patch_size=8, embed_dim=32, depth=2, num_heads=2
+        )
+        v = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=False
+        )
+        params = v["params"]
+        graph = build_graph(model, params)
+        assert set(graph.spaces) == {"block0/mlp/fc1", "block1/mlp/fc1"}
+        masks = _kill_channels(masking.make_masks(params), graph, 0.5)
+        x = np.random.default_rng(5).standard_normal((2, 32, 32, 3)).astype(
+            np.float32
+        )
+        dense, compacted, res = _dense_vs_compacted(
+            model,
+            lambda ov: VisionTransformer(
+                num_classes=10, patch_size=8, embed_dim=32, depth=2,
+                num_heads=2, width_overrides=tuple(sorted(ov.items())),
+            ),
+            params, {}, masks, x,
+        )
+        np.testing.assert_allclose(compacted, dense, atol=ATOL, rtol=1e-5)
+        assert np.asarray(res.params["block0"]["mlp"]["fc1"]["kernel"]).shape[-1] == 64
+
+    def test_vit_nonzero_fc1_bias_blocks_removal(self):
+        """GELU(0) = 0 but GELU(bias) != 0 for nonzero bias: a dead fc1
+        column with a nonzero bias entry must be kept."""
+        model = VisionTransformer(
+            num_classes=10, patch_size=8, embed_dim=32, depth=1, num_heads=2
+        )
+        v = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 16, 16, 3)), train=False
+        )
+        params = jax.tree.map(np.asarray, v["params"])
+        graph = build_graph(model, params)
+        masks = _kill_channels(masking.make_masks(params), graph, 0.25)
+        bias = np.array(params["block0"]["mlp"]["fc1"]["bias"])
+        n_dead = int(bias.shape[0] * 0.25)
+        bias[:n_dead] = 0.3
+        params["block0"]["mlp"]["fc1"]["bias"] = bias
+        res = compact_params(params, masks, graph)
+        rep = res.report["spaces"]["block0/mlp/fc1"]
+        assert rep["blocked_residue"] == n_dead and rep["kept"] == rep["channels"]
+
+    def test_unsupported_model_rejected(self):
+        with pytest.raises(CompactionError, match="no propagation graph"):
+            build_graph(object(), {})
+
+
+class TestHarnessCompactEval:
+    def test_compact_eval_matches_dense_eval(self, tmp_path):
+        """experiment_params.compact_eval: the test pass on the compacted
+        model reports the same metrics as the masked-dense scan path
+        (accuracy identical; loss within reassociation noise)."""
+        from turboprune_tpu.config.compose import compose
+        from turboprune_tpu.harness import PruningHarness
+        from turboprune_tpu.utils import gen_expt_dir
+
+        cfg = compose(
+            "cifar10_imp",
+            overrides=[
+                f"experiment_params.base_dir={tmp_path}",
+                "dataset_params.dataloader_type=synthetic",
+                "dataset_params.total_batch_size=16",
+                "dataset_params.synthetic_num_train=64",
+                "dataset_params.synthetic_num_test=32",
+                "experiment_params.epochs_per_level=1",
+                "experiment_params.max_steps_per_epoch=1",
+                "experiment_params.training_precision=float32",
+            ],
+        )
+        prefix, expt_dir = gen_expt_dir(cfg)
+        harness = PruningHarness(cfg, (prefix, expt_dir))
+        dense = harness.evaluate()
+        harness.cfg.experiment_params.compact_eval = True
+        compacted = harness.evaluate()
+        assert compacted["test_acc"] == dense["test_acc"]
+        np.testing.assert_allclose(
+            compacted["test_loss"], dense["test_loss"], rtol=1e-5
+        )
+        rep = harness.last_compaction_report
+        assert rep is not None and rep["arch"] == "resnet"
+        # Dense-trained all-ones masks: identity compaction.
+        assert rep["params_after"] == rep["params_before"]
+
+
+class TestTopKThresholdParity:
+    """Satellite: lax.top_k threshold selection must be bit-identical to the
+    jnp.sort path it replaced, including the k<1 no-op edge."""
+
+    @staticmethod
+    def _sort_global(scores, masks, density):
+        flat = jnp.concatenate(
+            [s.reshape(-1) for s in masking.mask_leaves(scores)]
+        ).astype(jnp.float32)
+        k = int((1.0 - density) * flat.shape[0])
+        if k < 1:
+            return masks
+        threshold = jnp.sort(flat)[k - 1]
+        return masking.mask_where(scores, lambda s: s > threshold)
+
+    @staticmethod
+    def _sort_per_layer(scores, densities):
+        def one(path, s):
+            d = densities[masking.path_name(path)]
+            k = int((1.0 - d) * s.size)
+            if k <= 0:
+                return s > 0.0
+            return s > jnp.sort(s.reshape(-1).astype(jnp.float32))[k - 1]
+
+        return masking._map_with_path_masked(one, scores)
+
+    @pytest.fixture(scope="class")
+    def scores(self):
+        model = create_model("resnet18", 10, "CIFAR10")
+        params = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=False
+        )["params"]
+        masks = masking.make_masks(params)
+        scores = masking.mask_where(
+            masks, lambda m, p: jnp.abs(p) * m.astype(p.dtype), params
+        )
+        return params, masks, scores
+
+    # 0.9999995: k = (1-d)*11.1M < 1 -> the no-op edge; 1.0 likewise.
+    @pytest.mark.parametrize("density", [0.9, 0.5, 0.2, 0.05, 0.9999995, 1.0])
+    def test_global_bit_identical(self, scores, density):
+        _, masks, s = scores
+        got = masking.global_threshold_mask(s, masks, density)
+        want = self._sort_global(s, masks, density)
+        for a, b in zip(masking.mask_leaves(got), masking.mask_leaves(want)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        if density == 1.0:
+            assert got is masks  # the documented no-op, not a copy
+
+    @pytest.mark.parametrize("density", [0.7, 0.1, 1.0])
+    def test_per_layer_bit_identical(self, scores, density):
+        _, masks, s = scores
+        densities = {
+            masking.path_name(p): density
+            for p, _ in masking.mask_leaves_with_path(masks)
+        }
+        got = masking.per_layer_threshold_mask(s, densities)
+        want = self._sort_per_layer(s, densities)
+        for a, b in zip(masking.mask_leaves(got), masking.mask_leaves(want)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -248,6 +248,11 @@ class ExperimentConfig:
     # re-enters the interrupted level at the saved epoch instead of
     # replaying it (beyond-reference; for preemptible TPUs).
     checkpoint_every_epochs: int = 0
+    # Opt-in: run the per-epoch test pass on the dead-channel-COMPACTED
+    # model (sparse/compact.py) instead of the masked-dense forward.
+    # Numerically equivalent up to fp reassociation; the per-level
+    # compaction report lands on harness.last_compaction_report.
+    compact_eval: bool = False
 
     def validate(self) -> None:
         _check_choice(
@@ -311,6 +316,13 @@ class ServeConfig:
     # Compile every bucket at startup (before the first request lands).
     warmup: bool = True
     request_timeout_s: float = 30.0
+    # Dead-channel compaction (sparse/): physically slice all-zero fan-out
+    # channels (and their BN/bias entries) out of the loaded checkpoint and
+    # AOT-compile the smaller model. Numerically equivalent to the
+    # masked-dense forward (up to fp reassociation); pays off only when the
+    # masks contain dead channels, not scattered zeros (README "Sparsity
+    # execution").
+    compact: bool = False
 
     def validate(self) -> None:
         if not self.batch_buckets:

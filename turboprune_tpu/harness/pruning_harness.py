@@ -173,6 +173,12 @@ class PruningHarness:
         self._eval_step = make_sharded_eval_step(raw_eval, self.mesh)
         self._scan_eval = make_sharded_scan_eval(make_scan_eval(raw_eval), self.mesh)
         self._eval_batches = None  # device-cached stacked test set
+        # Opt-in compacted eval (experiment_params.compact_eval): compiled
+        # eval steps cached by the compacted width signature — widths only
+        # change when the masks do (once per level), so per-epoch evals
+        # reuse one executable.
+        self._compact_eval_cache: dict[tuple, Any] = {}
+        self.last_compaction_report: Optional[dict] = None
 
     # ------------------------------------------------------------------ tx
     def _build_tx(self, epochs: int):
@@ -315,12 +321,19 @@ class PruningHarness:
 
     def evaluate(self) -> dict:
         """Full test pass (reference test, base_harness.py:204-245). For
-        schedule-free optimizers this evaluates the averaged weights."""
+        schedule-free optimizers this evaluates the averaged weights.
+
+        With ``experiment_params.compact_eval`` the pass runs on the
+        dead-channel-COMPACTED model instead (sparse/) — numerically
+        equivalent up to fp reassociation, and the per-level size report
+        lands on ``last_compaction_report``."""
         ev_state = self.state
         if self.cfg.optimizer_params.optimizer_name == "ScheduleFreeSGD":
             ev_state = ev_state.replace(
                 params=eval_params(ev_state.opt_state, ev_state.params)
             )
+        if self.cfg.experiment_params.compact_eval:
+            return self._evaluate_compacted(ev_state)
         test_loader = self.loaders.test_loader
         if hasattr(test_loader, "eval_epoch_arrays"):
             # Device-resident eval: the padded stacked test set is cached in
@@ -341,6 +354,64 @@ class PruningHarness:
             if sums is None:
                 raise RuntimeError("test loader yielded no batches")
             sums = jax.device_get(sums)
+        n = float(sums["count"])
+        return {
+            "test_loss": float(sums["loss_sum"]) / n,
+            "test_acc": 100.0 * float(sums["correct"]) / n,
+        }
+
+    def _evaluate_compacted(self, ev_state) -> dict:
+        """Test pass on the physically compacted model (sparse/compact.py).
+
+        The current state's masks are analyzed on the host, dead channels
+        are sliced out, and the small model evaluates the same test set.
+        Single-program (no mesh step): eval batches are replicated-small
+        and the compacted executable is cached per width signature, so
+        within a level every epoch reuses one compile. Ring attention falls
+        back to its param-identical dense equivalent (as in serving)."""
+        from ..sparse import build_graph, compact_params
+        from ..train.state import TrainState
+
+        graph = build_graph(self.model, ev_state.params)
+        res = compact_params(
+            ev_state.params, ev_state.masks, graph, ev_state.batch_stats
+        )
+        self.last_compaction_report = res.report
+        key = res.as_override_tuple()
+        if key not in self._compact_eval_cache:
+            attention_impl = self.cfg.model_params.attention_impl
+            if attention_impl == "ring":
+                attention_impl = "dense"
+            small_model = create_model(
+                self.cfg.model_params.model_name,
+                num_classes=self.cfg.dataset_params.num_classes,
+                dataset_name=self.cfg.dataset_params.dataset_name,
+                compute_dtype=self.compute_dtype,
+                attention_impl=attention_impl,
+                width_overrides=res.width_overrides,
+            )
+            self._compact_eval_cache[key] = jax.jit(
+                make_eval_step(small_model)
+            )
+        step = self._compact_eval_cache[key]
+        # make_eval_step multiplies masks into params; all-ones masks on
+        # the compacted (already folded) params make that an exact no-op,
+        # so the metric/padding semantics are shared with the dense path.
+        small_state = TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=res.params,
+            masks=masking.make_masks(res.params),
+            batch_stats=res.batch_stats,
+            opt_state=(),
+            rng=jnp.zeros((), jnp.uint32),  # unused in eval
+        )
+        sums = None
+        for batch in self.loaders.test_loader:
+            m = step(small_state, batch)
+            sums = m if sums is None else jax.tree.map(jnp.add, sums, m)
+        if sums is None:
+            raise RuntimeError("test loader yielded no batches")
+        sums = jax.device_get(sums)
         n = float(sums["count"])
         return {
             "test_loss": float(sums["loss_sum"]) / n,

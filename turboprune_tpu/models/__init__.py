@@ -55,13 +55,18 @@ def create_model(
     compute_dtype: Any = jnp.float32,
     attention_impl: str = "dense",
     mesh: Any = None,
+    width_overrides: Any = None,
 ):
     """Build a model module with dataset-appropriate stem.
 
     CIFAR datasets get the reference's stem surgery
     (custom_models.py:197-215) via ``cifar_stem=True``. ViT models accept
     ``attention_impl="ring"`` + a mesh for sequence-parallel attention
-    (parallel/ring.py); CNNs reject it (no attention to shard)."""
+    (parallel/ring.py); CNNs reject it (no attention to shard).
+
+    ``width_overrides`` (mapping of space name -> kept channels, from
+    ``sparse.compact_params``) re-instantiates a dead-channel-compacted
+    model; normalized to a sorted tuple so the module stays hashable."""
     if model_name not in MODEL_REGISTRY:
         raise ValueError(
             f"Model {model_name!r} not in registry: {sorted(MODEL_REGISTRY)}"
@@ -75,6 +80,8 @@ def create_model(
             f"attention_impl={attention_impl!r} requires a ViT model "
             f"(got {model_name!r})"
         )
+    if width_overrides:
+        kwargs["width_overrides"] = tuple(sorted(dict(width_overrides).items()))
     return MODEL_REGISTRY[model_name](
         num_classes, cifar_stem=cifar_stem, dtype=compute_dtype, **kwargs
     )

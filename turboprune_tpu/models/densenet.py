@@ -33,16 +33,24 @@ class DenseLayer(nn.Module):
     conv: ModuleDef
     norm: ModuleDef
     bottleneck_width: int = 4
+    # Compacted widths (sparse/compact.py): bottleneck 1x1 output and the
+    # growth (concat segment) output; None keeps the dense width.
+    bottleneck_channels: Any = None
+    growth_channels: Any = None
 
     @nn.compact
     def __call__(self, x):
         y = self.norm(name="norm1")(x)
         y = nn.relu(y)
-        y = self.conv(self.bottleneck_width * self.growth_rate, (1, 1),
-                      name="conv1")(y)
+        y = self.conv(
+            self.bottleneck_channels or self.bottleneck_width * self.growth_rate,
+            (1, 1), name="conv1",
+        )(y)
         y = self.norm(name="norm2")(y)
         y = nn.relu(y)
-        y = self.conv(self.growth_rate, (3, 3), name="conv2")(y)
+        y = self.conv(
+            self.growth_channels or self.growth_rate, (3, 3), name="conv2"
+        )(y)
         return jnp.concatenate([x, y], axis=-1)
 
 
@@ -69,6 +77,10 @@ class DenseNet(nn.Module):
     bn_momentum: float = 0.9
     bn_epsilon: float = 1e-5
     bn_cross_replica_axis: Any = None
+    # Per-space channel widths for compacted models (sparse/compact.py):
+    # "conv0" / "denseblock{i}_layer{j}/conv{1,2}" / "transition{i}/conv"
+    # -> kept channels. Mapping or tuple of pairs; absent keys stay dense.
+    width_overrides: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -87,13 +99,15 @@ class DenseNet(nn.Module):
             axis_name=self.bn_cross_replica_axis,
         )
         x = x.astype(self.dtype)
+        ov = dict(self.width_overrides or {})
+        stem_features = ov.get("conv0", self.init_features)
         if self.cifar_stem:
-            x = conv(self.init_features, (3, 3), name="conv0")(x)
+            x = conv(stem_features, (3, 3), name="conv0")(x)
             x = norm(name="norm0")(x)
             x = nn.relu(x)
         else:
             x = conv(
-                self.init_features, (7, 7), strides=(2, 2),
+                stem_features, (7, 7), strides=(2, 2),
                 padding=[(3, 3), (3, 3)], name="conv0",
             )(x)
             x = norm(name="norm0")(x)
@@ -103,15 +117,19 @@ class DenseNet(nn.Module):
         features = self.init_features
         for i, layers in enumerate(self.block_sizes):
             for j in range(layers):
+                name = f"denseblock{i + 1}_layer{j + 1}"
                 x = DenseLayer(
                     growth_rate=self.growth_rate, conv=conv, norm=norm,
-                    name=f"denseblock{i + 1}_layer{j + 1}",
+                    name=name,
+                    bottleneck_channels=ov.get(f"{name}/conv1"),
+                    growth_channels=ov.get(f"{name}/conv2"),
                 )(x)
             features += layers * self.growth_rate
             if i + 1 < len(self.block_sizes):
                 features //= 2  # torchvision 0.5 compression
                 x = Transition(
-                    out_features=features, conv=conv, norm=norm,
+                    out_features=ov.get(f"transition{i + 1}/conv", features),
+                    conv=conv, norm=norm,
                     name=f"transition{i + 1}",
                 )(x)
         x = norm(name="norm_final")(x)

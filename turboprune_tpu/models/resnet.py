@@ -37,11 +37,17 @@ class BasicBlock(nn.Module):
     conv: ModuleDef
     norm: ModuleDef
     expansion: int = 1
+    # Compacted per-block inner widths (sparse/compact.py): BasicBlock's
+    # only block-internal channel axis is Conv_0's output; the second conv
+    # produces the block output, which is shared through the residual add
+    # and never compacted.
+    inner_widths: Any = None
 
     @nn.compact
     def __call__(self, x):
         residual = x
-        y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides))(x)
+        w0 = (self.inner_widths or (None,))[0] or self.filters
+        y = self.conv(w0, (3, 3), strides=(self.strides, self.strides))(x)
         y = self.norm()(y)
         y = nn.relu(y)
         y = self.conv(self.filters, (3, 3))(y)
@@ -66,16 +72,20 @@ class Bottleneck(nn.Module):
     # torchvision wide_resnet*_2: inner 1x1/3x3 width doubles
     # (width_per_group=128) while the block output stays filters*expansion.
     inner_multiplier: float = 1.0
+    # Compacted inner widths for (Conv_0, Conv_1); the 1x1 expansion conv
+    # produces the residual-shared block output and is never compacted.
+    inner_widths: Any = None
 
     @nn.compact
     def __call__(self, x):
         residual = x
         inner = int(self.filters * self.inner_multiplier)
-        y = self.conv(inner, (1, 1))(x)
+        iw = self.inner_widths or (None, None)
+        y = self.conv(iw[0] or inner, (1, 1))(x)
         y = self.norm()(y)
         y = nn.relu(y)
         # torchvision puts the stride on the 3x3 conv (ResNet v1.5)
-        y = self.conv(inner, (3, 3), strides=(self.strides, self.strides))(y)
+        y = self.conv(iw[1] or inner, (3, 3), strides=(self.strides, self.strides))(y)
         y = self.norm()(y)
         y = nn.relu(y)
         y = self.conv(self.filters * self.expansion, (1, 1))(y)
@@ -104,6 +114,11 @@ class ResNet(nn.Module):
     bn_momentum: float = 0.9  # = 1 - torch BatchNorm momentum 0.1
     bn_epsilon: float = 1e-5
     bn_cross_replica_axis: Optional[str] = None
+    # Per-space channel widths for compacted models (sparse/compact.py):
+    # mapping (or tuple of pairs — hashable for Module cloning) from
+    # "layer{i}_{j}/Conv_{k}" to the kept channel count of that
+    # block-internal axis. None/absent keys keep the dense width.
+    width_overrides: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -138,15 +153,24 @@ class ResNet(nn.Module):
             if self.inner_multiplier != 1.0
             else {}
         )
+        ov = dict(self.width_overrides or {})
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = 2 if i > 0 and j == 0 else 1
+                name = f"layer{i + 1}_{j}"
+                inner_widths = (
+                    ov.get(f"{name}/Conv_0"),
+                    ov.get(f"{name}/Conv_1"),
+                )
                 x = self.block_cls(
                     filters=self.width * 2**i,
                     strides=strides,
                     conv=conv,
                     norm=norm,
-                    name=f"layer{i + 1}_{j}",
+                    name=name,
+                    inner_widths=(
+                        inner_widths if any(inner_widths) else None
+                    ),
                     **block_kw,
                 )(x)
         x = jnp.mean(x, axis=(1, 2))

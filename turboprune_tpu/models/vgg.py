@@ -55,6 +55,13 @@ class VGG(nn.Module):
     dropout_rate: float = 0.5
     bn_momentum: float = 0.9
     bn_epsilon: float = 1e-5
+    # Hidden classifier widths (torchvision: 4096/4096); configurable so
+    # compaction can shrink them and small test instantiations stay cheap.
+    fc_features: Sequence[int] = (4096, 4096)
+    # Per-space channel widths for compacted models (sparse/compact.py):
+    # "conv{k}" / "fc0" / "fc1" -> kept channel count. Mapping or tuple of
+    # pairs (hashable for Module cloning); absent keys keep dense widths.
+    width_overrides: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -66,13 +73,15 @@ class VGG(nn.Module):
                 f"VGG needs inputs >= 32x32, got {x.shape[1]}x{x.shape[2]}"
             )
         x = x.astype(self.dtype)
+        ov = dict(self.width_overrides or {})
         conv_idx = 0
         for v in self.cfg:
             if v == "M":
                 x = nn.max_pool(x, (2, 2), strides=(2, 2))
             else:
                 x = nn.Conv(
-                    v, (3, 3), padding=[(1, 1), (1, 1)], use_bias=True,
+                    ov.get(f"conv{conv_idx}", v), (3, 3),
+                    padding=[(1, 1), (1, 1)], use_bias=True,
                     dtype=self.dtype, name=f"conv{conv_idx}",
                 )(x)
                 if self.batch_norm:
@@ -87,10 +96,14 @@ class VGG(nn.Module):
                 conv_idx += 1
         x = adaptive_avg_pool(x, 7)
         x = x.reshape((x.shape[0], -1)).astype(jnp.float32)
-        x = nn.Dense(4096, dtype=jnp.float32, name="fc0")(x)
+        x = nn.Dense(
+            ov.get("fc0", self.fc_features[0]), dtype=jnp.float32, name="fc0"
+        )(x)
         x = nn.relu(x)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
-        x = nn.Dense(4096, dtype=jnp.float32, name="fc1")(x)
+        x = nn.Dense(
+            ov.get("fc1", self.fc_features[1]), dtype=jnp.float32, name="fc1"
+        )(x)
         x = nn.relu(x)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         x = nn.Dense(self.num_classes, dtype=jnp.float32, name="fc2")(x)

@@ -146,6 +146,8 @@ class EncoderBlock(nn.Module):
     # "dense" | "ring" (sequence-parallel) | "flash" (Pallas blockwise)
     attention_impl: str = "dense"
     mesh: Any = None  # required for attention_impl="ring"
+    # Compacted MLP hidden width (sparse/compact.py); None = dim*mlp_ratio.
+    mlp_hidden: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -180,7 +182,7 @@ class EncoderBlock(nn.Module):
         x = x + y
         y = nn.LayerNorm(epsilon=1e-6, name="norm2")(x)
         y = MlpBlock(
-            hidden_dim=int(dim * self.mlp_ratio),
+            hidden_dim=self.mlp_hidden or int(dim * self.mlp_ratio),
             out_dim=dim,
             dropout_rate=self.dropout_rate,
             dtype=self.dtype,
@@ -204,6 +206,10 @@ class VisionTransformer(nn.Module):
     # params/checkpoints to "dense".
     attention_impl: str = "dense"
     mesh: Any = None
+    # Per-space channel widths for compacted models (sparse/compact.py):
+    # "block{i}/mlp/fc1" -> kept hidden width. Mapping or tuple of pairs;
+    # absent keys keep dim * mlp_ratio.
+    width_overrides: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -243,6 +249,7 @@ class VisionTransformer(nn.Module):
         x = x + pos.astype(self.dtype)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
 
+        ov = dict(self.width_overrides or {})
         for i in range(self.depth):
             x = EncoderBlock(
                 num_heads=self.num_heads,
@@ -251,6 +258,7 @@ class VisionTransformer(nn.Module):
                 dtype=self.dtype,
                 attention_impl=self.attention_impl,
                 mesh=self.mesh,
+                mlp_hidden=ov.get(f"block{i}/mlp/fc1"),
                 name=f"block{i}",
             )(x, train=train)
         x = nn.LayerNorm(epsilon=1e-6, name="norm")(x)

@@ -34,7 +34,7 @@ from ..config.schema import config_from_dict
 from ..models import create_model
 from ..ops import masking
 from ..train.state import init_variables
-from ..utils.checkpoint import ExperimentCheckpoints, restore_pytree
+from ..utils.checkpoint import ExperimentCheckpoints, restore_model_tree
 
 DEFAULT_BUCKETS = (1, 8, 32, 128)
 
@@ -57,6 +57,8 @@ class InferenceEngine:
         metrics=None,
         level: Optional[int] = None,
         source: str = "",
+        compact: bool = False,
+        model_factory=None,
     ):
         self.model = model
         self.buckets = tuple(sorted({int(b) for b in buckets}))
@@ -67,12 +69,37 @@ class InferenceEngine:
         self.level = level
         self.source = source
         self.density = masking.overall_density(masks)
-        # Fold once: pruned weights become literal zeros in the served
-        # params, so per-request forwards skip the mask multiply entirely.
-        folded = masking.apply_masks(params, masks)
-        self._variables = {"params": folded}
-        if batch_stats:
-            self._variables["batch_stats"] = batch_stats
+        self.compaction: Optional[dict] = None
+        if compact:
+            # Dead-channel compaction (sparse/): slice all-zero fan-out
+            # channels out of the checkpoint and serve the physically
+            # smaller model — the AOT lower below then compiles the smaller
+            # HLO. Numerically equivalent to the masked-dense forward up to
+            # fp reassociation (tests/test_sparse.py pins the tolerance).
+            from ..sparse import build_graph, compact_params
+
+            graph = build_graph(model, params)
+            result = compact_params(params, masks, graph, batch_stats)
+            factory = model_factory or (
+                lambda ov: model.clone(
+                    width_overrides=tuple(sorted(ov.items()))
+                )
+            )
+            self.model = factory(result.width_overrides)
+            self.compaction = result.report
+            self._variables = {"params": result.params}
+            if result.batch_stats:
+                self._variables["batch_stats"] = result.batch_stats
+            if metrics:
+                metrics.record_compaction(result.report)
+        else:
+            # Fold once: pruned weights become literal zeros in the served
+            # params, so per-request forwards skip the mask multiply
+            # entirely.
+            folded = masking.apply_masks(params, masks)
+            self._variables = {"params": folded}
+            if batch_stats:
+                self._variables["batch_stats"] = batch_stats
         self.num_classes = None  # set by the first compile (output aval)
         self._compiled: dict[int, Any] = {}
         self._compile_lock = threading.Lock()
@@ -160,7 +187,7 @@ class InferenceEngine:
         return np.asarray(jax.device_get(logits), np.float32)[:k]
 
     def info(self) -> dict:
-        return {
+        out = {
             "level": self.level,
             "density": round(float(self.density), 6),
             "buckets": list(self.buckets),
@@ -169,6 +196,15 @@ class InferenceEngine:
             "num_classes": self.num_classes,
             "source": self.source,
         }
+        if self.compaction is not None:
+            out["compaction"] = {
+                "params_before": self.compaction["params_before"],
+                "params_after": self.compaction["params_after"],
+                "channels_before": self.compaction["channels_before"],
+                "channels_after": self.compaction["channels_after"],
+                "compacted_spaces": self.compaction["compacted_spaces"],
+            }
+        return out
 
     # -------------------------------------------------------- construction
     @classmethod
@@ -181,6 +217,7 @@ class InferenceEngine:
         buckets: Sequence[int] = DEFAULT_BUCKETS,
         metrics=None,
         precision: Optional[str] = None,
+        compact: bool = False,
     ) -> "InferenceEngine":
         """Build from an experiment directory written by the driver.
 
@@ -217,7 +254,7 @@ class InferenceEngine:
         )
         input_shape = (dp.image_size, dp.image_size, 3)
         variables = init_variables(
-            # graftlint: disable=rng-key-reuse -- shape-only init: every initialized weight is overwritten by restore_pytree below; the key value can never reach served outputs
+            # graftlint: disable=rng-key-reuse -- shape-only init: every initialized weight is overwritten by restore_model_tree below; the key value can never reach served outputs
             model, jax.random.PRNGKey(0), (1, *input_shape)
         )
         like = {
@@ -241,7 +278,7 @@ class InferenceEngine:
             path = ckpts.level_path(level)
         if not path.exists():
             raise FileNotFoundError(f"checkpoint {path} does not exist")
-        restored = restore_pytree(path, like)
+        restored = restore_model_tree(path, like)
         return cls(
             model,
             restored["params"],
@@ -252,4 +289,15 @@ class InferenceEngine:
             metrics=metrics,
             level=level,
             source=str(path),
+            compact=compact,
+            # Re-instantiate through create_model so the compacted model
+            # gets the exact same stem/dtype/attention wiring.
+            model_factory=lambda ov: create_model(
+                cfg.model_params.model_name,
+                num_classes=dp.num_classes,
+                dataset_name=dp.dataset_name,
+                compute_dtype=dtype,
+                attention_impl=attention_impl,
+                width_overrides=ov,
+            ),
         )

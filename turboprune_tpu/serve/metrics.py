@@ -56,6 +56,19 @@ class ServeMetrics:
     def compile_miss(self) -> None:
         self.inc("compile_cache_misses_total")
 
+    def record_compaction(self, report: dict) -> None:
+        """Export the dead-channel compaction outcome (sparse/compact.py) as
+        gauges: dense vs compacted parameter and channel counts, so a
+        scraper (or the bench) can read the size the server ACTUALLY
+        compiled, not just the mask density."""
+        self.set_gauge("compaction_params_dense", report["params_before"])
+        self.set_gauge("compaction_params_compacted", report["params_after"])
+        self.set_gauge("compaction_channels_dense", report["channels_before"])
+        self.set_gauge(
+            "compaction_channels_compacted", report["channels_after"]
+        )
+        self.set_gauge("compaction_spaces_compacted", report["compacted_spaces"])
+
     def observe_latency_ms(self, ms: float) -> None:
         with self._lock:
             i = bisect.bisect_left(LATENCY_BUCKETS_MS, ms)

@@ -221,6 +221,7 @@ def build_server(
         role=sc.checkpoint_role,
         buckets=tuple(sc.batch_buckets),
         metrics=metrics,
+        compact=sc.compact,
     )
     if sc.warmup:
         engine.warmup()

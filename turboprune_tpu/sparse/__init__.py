@@ -1,0 +1,26 @@
+"""Make sparsity pay: dead-channel compaction for eval/serving.
+
+graph.py    mask-structure analysis — channel spaces with per-architecture
+            propagation (VGG chains, ResNet stops at residual joins,
+            DenseNet concat-aware offsets, ViT MLP blocks)
+compact.py  ``compact_params`` — physically slice dead channels out of
+            params/bias/BN leaves, returning smaller dense tensors + the
+            ``width_overrides`` needed to re-instantiate the model, with a
+            numeric-residue guard that keeps any dead channel whose
+            relu(bn(0)) constant is nonzero (exactness over size)
+
+Consumed by serve/engine.py (``compact: true`` load path), the harness's
+opt-in compacted eval, and bench.py's ``compaction`` stage.
+"""
+
+from .compact import CompactionResult, analyze_masks, compact_params
+from .graph import CompactionError, PropagationGraph, build_graph
+
+__all__ = [
+    "CompactionError",
+    "CompactionResult",
+    "PropagationGraph",
+    "analyze_masks",
+    "build_graph",
+    "compact_params",
+]

@@ -1,0 +1,246 @@
+"""Dead-channel compaction: pytree -> smaller pytree, numerically faithful.
+
+``compact_params`` takes the training representation (raw params + boolean
+mask pytree, JaxPruner-style mask-as-pytree — PAPERS.md) and a propagation
+graph (graph.py) and returns physically smaller dense tensors plus the
+per-space channel widths needed to re-instantiate the model
+(``models.create_model(..., width_overrides=...)``).
+
+Equivalence contract — bit-exact up to fp reassociation vs the
+masked-dense forward (``apply_masks`` inside jit):
+
+  1. Masks are folded first (``w * m`` is exact), so scattered zeros inside
+     KEPT channels stay zeros in the compacted tensors.
+  2. A channel is removed only when (a) its producer's fan-out mask slice
+     is ALL zero, and (b) its post-activation residue is exactly zero at
+     every consumer. (b) matters because a dead conv channel still emits
+     relu(bn(0)) — a per-channel CONSTANT that is nonzero whenever the BN
+     bias/stats make it so. Removing such a channel would change consumer
+     outputs, so it is KEPT and counted in the report
+     (``blocked_residue``); only channels whose downstream contribution is
+     identically zero are sliced away. Residues are evaluated in float64;
+     ReLU clamps any non-positive residue to an exact 0.0, so the check is
+     exact there, and GELU underflows to +-0.0 only for inputs whose
+     contribution is below fp resolution anyway.
+  3. What remains is the same arithmetic with the zero terms of the
+     reductions removed — XLA may re-fuse/reorder the smaller sums, hence
+     "up to fp reassociation" (tests pin tolerances).
+
+Refusal: a space whose every channel is removable would re-instantiate as
+a zero-width conv/dense — the model is degenerate (that layer's output is
+a constant) and silently serving it would be dishonest; CompactionError
+instead.
+"""
+
+from __future__ import annotations
+
+import math
+from collections.abc import Mapping
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from ..ops.masking import apply_masks
+from .graph import CompactionError, PathT, PropagationGraph, _tree_get
+
+_ERF = np.vectorize(math.erf)
+
+
+@dataclass
+class CompactionResult:
+    params: Any                       # compacted, mask-FOLDED params
+    batch_stats: Any                  # compacted BN running stats
+    width_overrides: dict             # space override_key -> kept channels
+    report: dict
+
+    def as_override_tuple(self) -> tuple:
+        """Hashable form for flax Module fields / cache keys."""
+        return tuple(sorted(self.width_overrides.items()))
+
+
+# ------------------------------------------------------------------ helpers
+def _np(leaf) -> np.ndarray:
+    return np.asarray(jax.device_get(leaf))
+
+
+def _map_leaves(tree: Any, fn, prefix: PathT = ()):
+    """Rebuild a nested mapping with ``fn(path, leaf)`` at each leaf; plain
+    dicts out (flax accepts them as variables)."""
+    if isinstance(tree, Mapping):
+        return {
+            str(k): _map_leaves(v, fn, prefix + (str(k),))
+            for k, v in tree.items()
+        }
+    return fn(prefix, tree)
+
+
+def _apply_gate(gate, v: np.ndarray, params, batch_stats) -> np.ndarray:
+    """Run a per-channel op chain on a float64 residue vector."""
+    for op in gate:
+        if op[0] == "bn":
+            _, module, eps = op
+            p = _tree_get(params, module)
+            s = _tree_get(batch_stats, module)
+            scale = _np(p["scale"]).astype(np.float64)
+            bias = _np(p["bias"]).astype(np.float64)
+            mean = _np(s["mean"]).astype(np.float64)
+            var = _np(s["var"]).astype(np.float64)
+            v = scale * (v - mean) / np.sqrt(var + eps) + bias
+        elif op[0] == "relu":
+            v = np.maximum(v, 0.0)
+        elif op[0] == "gelu":
+            v = 0.5 * v * (1.0 + _ERF(v / math.sqrt(2.0)))
+        else:  # pragma: no cover - graph builders only emit the three above
+            raise CompactionError(f"unknown gate op {op!r}")
+    return v
+
+
+# ----------------------------------------------------------------- analysis
+def analyze_masks(
+    params: Any,
+    masks: Any,
+    graph: PropagationGraph,
+    batch_stats: Optional[Any] = None,
+) -> tuple[dict[str, np.ndarray], dict]:
+    """Per-space boolean keep vectors + report.
+
+    keep[c] = not (fan-out slice all-masked AND residue at every consumer
+    exactly zero). Raises CompactionError when a space keeps 0 channels."""
+    batch_stats = batch_stats or {}
+    dead: dict[str, np.ndarray] = {}
+    raw_residue: dict[str, np.ndarray] = {}
+    for name, sp in graph.spaces.items():
+        m = _tree_get(masks, sp.producer.kernel)
+        if m is None:
+            raise CompactionError(
+                f"no mask at {'/'.join(sp.producer.kernel)} — compaction "
+                "needs the boolean mask tree of the prunable kernels"
+            )
+        m = _np(m)
+        dead[name] = ~m.reshape(-1, m.shape[-1]).any(axis=0)
+        if sp.producer.bias is not None:
+            raw = _np(_tree_get(params, sp.producer.bias)).astype(np.float64)
+        else:
+            raw = np.zeros(sp.channels, np.float64)
+        raw_residue[name] = _apply_gate(sp.post, raw, params, batch_stats)
+
+    # A dead channel whose residue is nonzero at ANY consumer must stay.
+    blocked: dict[str, np.ndarray] = {
+        name: np.zeros(sp.channels, bool) for name, sp in graph.spaces.items()
+    }
+    for consumer in graph.consumers:
+        vec = np.concatenate([raw_residue[s] for s in consumer.segments])
+        vec = _apply_gate(consumer.gate, vec, params, batch_stats)
+        off = 0
+        for seg in consumer.segments:
+            n = graph.spaces[seg].channels
+            blocked[seg] |= vec[off : off + n] != 0.0
+            off += n
+
+    keeps: dict[str, np.ndarray] = {}
+    space_report: dict[str, dict] = {}
+    for name, sp in graph.spaces.items():
+        removable = dead[name] & ~blocked[name]
+        keep = ~removable
+        if not keep.any():
+            raise CompactionError(
+                f"space {name!r}: all {sp.channels} channels are dead — the "
+                "compacted layer would have zero width (its output is a "
+                "constant); refusing to build a degenerate model"
+            )
+        keeps[name] = keep
+        space_report[name] = {
+            "channels": int(sp.channels),
+            "kept": int(keep.sum()),
+            "dead": int(dead[name].sum()),
+            "blocked_residue": int((dead[name] & blocked[name]).sum()),
+        }
+    report = {
+        "arch": graph.arch,
+        "spaces": space_report,
+        "channels_before": int(sum(sp.channels for sp in graph.spaces.values())),
+        "channels_after": int(sum(k.sum() for k in keeps.values())),
+    }
+    return keeps, report
+
+
+# --------------------------------------------------------------- compaction
+def compact_params(
+    params: Any,
+    masks: Any,
+    graph: PropagationGraph,
+    batch_stats: Optional[Any] = None,
+) -> CompactionResult:
+    """Slice dead channels out of params/batch_stats along the graph.
+
+    Returns mask-folded, physically smaller tensors plus the
+    ``width_overrides`` mapping that re-instantiates the matching model.
+    Leaves not named by the graph (trunk convs, attention projections,
+    classifier heads, frozen residual axes) are folded but keep their
+    shape."""
+    batch_stats = batch_stats or {}
+    keeps, report = analyze_masks(params, masks, graph, batch_stats)
+
+    out_keep: dict[PathT, np.ndarray] = {}   # kernel/bias/attached -> keep
+    in_keep: dict[PathT, np.ndarray] = {}    # kernel -> in-axis keep
+    stats_keep: dict[PathT, np.ndarray] = {}
+    for name, sp in graph.spaces.items():
+        keep = keeps[name]
+        out_keep[sp.producer.kernel] = keep
+        if sp.producer.bias is not None:
+            out_keep[sp.producer.bias] = keep
+        for path in sp.attached_params:
+            out_keep[path] = keep
+        for path in sp.attached_stats:
+            stats_keep[path] = keep
+    for consumer in graph.consumers:
+        keep = np.concatenate([keeps[s] for s in consumer.segments])
+        # Consumer-side BN leaves span the concatenated (pre-flatten) axis.
+        for path in consumer.attached_params:
+            out_keep[path] = keep
+        for path in consumer.attached_stats:
+            stats_keep[path] = keep
+        if consumer.repeat != 1:
+            keep = np.tile(keep, consumer.repeat)
+        in_keep[consumer.kernel] = keep
+
+    folded = apply_masks(params, masks)
+
+    def slice_param(path: PathT, leaf):
+        arr = _np(leaf)
+        ik = in_keep.get(path)
+        if ik is not None:
+            arr = arr[..., ik, :]
+        ok = out_keep.get(path)
+        if ok is not None:
+            arr = arr[..., ok]
+        return arr
+
+    def slice_stat(path: PathT, leaf):
+        keep = stats_keep.get(path)
+        arr = _np(leaf)
+        return arr[..., keep] if keep is not None else arr
+
+    new_params = _map_leaves(folded, slice_param)
+    new_stats = _map_leaves(batch_stats, slice_stat) if batch_stats else {}
+
+    width_overrides = {
+        sp.override_key: int(keeps[name].sum())
+        for name, sp in graph.spaces.items()
+        if int(keeps[name].sum()) != sp.channels
+    }
+    before = sum(int(np.size(_np(x))) for x in jax.tree.leaves(params))
+    after = sum(int(x.size) for x in jax.tree.leaves(new_params))
+    report.update(
+        params_before=before,
+        params_after=after,
+        compacted_spaces=len(width_overrides),
+    )
+    return CompactionResult(
+        params=new_params,
+        batch_stats=new_stats,
+        width_overrides=width_overrides,
+        report=report,
+    )

@@ -8,9 +8,13 @@ from .checkpoint import (
     OPTIMIZER_INIT,
     OPTIMIZER_REWIND,
     ExperimentCheckpoints,
+    pack_mask_tree,
     reset_weights,
+    restore_model_tree,
     restore_pytree,
+    save_model_tree,
     save_pytree,
+    unpack_mask_tree,
 )
 from .experiment import (
     MetricsLogger,
@@ -28,6 +32,10 @@ __all__ = [
     "reset_weights",
     "save_pytree",
     "restore_pytree",
+    "save_model_tree",
+    "restore_model_tree",
+    "pack_mask_tree",
+    "unpack_mask_tree",
     "MID_LEVEL",
     "MODEL_INIT",
     "MODEL_REWIND",

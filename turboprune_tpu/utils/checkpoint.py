@@ -21,6 +21,7 @@ restored — the freshly pruned masks always survive a rewind.
 
 from __future__ import annotations
 
+import json
 import os
 import re
 from pathlib import Path
@@ -107,6 +108,107 @@ def restore_pytree(path: str | Path, like: Optional[PyTree] = None) -> PyTree:
     return ckptr.restore(path, abstract)
 
 
+# --- bit-packed mask payloads --------------------------------------------
+# Boolean mask trees serialize 1 byte/element; at ResNet50 scale that is
+# ~25 MB of masks PER checkpoint role, all of it bits. Masks are packed to
+# uint8 bitfields (np.packbits — host-side; the save path materializes
+# numpy anyway) with an explicit shape vector per leaf, an 8x smaller
+# payload. Checkpoints written before this change carry raw bool masks;
+# ``restore_model_tree`` detects which layout is on disk from Orbax's
+# _METADATA manifest and reads either, so legacy experiment dirs stay
+# loadable.
+
+MASKS_KEY = "masks"
+MASKS_PACKED_KEY = "masks_packed"
+
+
+def _is_none(x) -> bool:
+    return x is None
+
+
+def pack_mask_tree(masks: PyTree) -> PyTree:
+    """bool leaves -> {"bits": uint8[ceil(n/8)], "shape": int64[ndim]};
+    None leaves (non-prunable positions) pass through."""
+
+    def pack(m):
+        if m is None:
+            return None
+        arr = np.asarray(jax.device_get(m)).astype(bool)
+        return {
+            "bits": np.packbits(arr.reshape(-1)),
+            "shape": np.asarray(arr.shape, np.int64),
+        }
+
+    return jax.tree.map(pack, masks, is_leaf=_is_none)
+
+
+def unpack_mask_tree(packed: PyTree) -> PyTree:
+    """Inverse of pack_mask_tree; shapes come from the stored metadata."""
+
+    def unpack(leaf):
+        if leaf is None:
+            return None
+        shape = tuple(int(s) for s in np.asarray(leaf["shape"]))
+        n = int(np.prod(shape)) if shape else 1
+        bits = np.unpackbits(np.asarray(leaf["bits"]), count=n)
+        return bits.astype(bool).reshape(shape)
+
+    def is_packed_leaf(x):
+        return x is None or (isinstance(x, dict) and set(x) == {"bits", "shape"})
+
+    return jax.tree.map(unpack, packed, is_leaf=is_packed_leaf)
+
+
+def packed_mask_like(masks_like: PyTree) -> PyTree:
+    """Abstract packed tree (for restore-with-like) from an unpacked
+    mask-tree template — shapes are derivable: prod(shape) bits."""
+
+    def like(m):
+        if m is None:
+            return None
+        n = int(np.prod(m.shape)) if m.shape else 1
+        return {
+            "bits": np.zeros((n + 7) // 8, np.uint8),
+            "shape": np.zeros(len(m.shape), np.int64),
+        }
+
+    return jax.tree.map(like, masks_like, is_leaf=_is_none)
+
+
+def _has_packed_masks(path: Path) -> bool:
+    """Did this checkpoint serialize masks bit-packed? Read from Orbax's
+    _METADATA manifest (tree_metadata keys are stringified key-paths);
+    unreadable/absent manifest -> assume the legacy raw-bool layout."""
+    try:
+        meta = json.loads((Path(path) / "_METADATA").read_text())
+    except (OSError, ValueError):
+        return False
+    keys = meta.get("tree_metadata", {})
+    return any(f"'{MASKS_PACKED_KEY}'" in k for k in keys)
+
+
+def save_model_tree(path: str | Path, tree: dict) -> None:
+    """Save a model-role tree ({"params", "masks", ...extras}) with the
+    mask payload bit-packed under ``masks_packed``."""
+    out = dict(tree)
+    out[MASKS_PACKED_KEY] = pack_mask_tree(out.pop(MASKS_KEY))
+    save_pytree(path, out)
+
+
+def restore_model_tree(path: str | Path, like: dict) -> dict:
+    """Restore a model-role tree against an UNPACKED ``like`` (with a
+    "masks" entry), transparently handling both layouts: bit-packed
+    (current) and raw bool (legacy checkpoints from before the packing
+    change). Returns the unpacked form either way."""
+    if not _has_packed_masks(Path(path).resolve()):
+        return restore_pytree(path, like)
+    plike = dict(like)
+    plike[MASKS_PACKED_KEY] = packed_mask_like(plike.pop(MASKS_KEY))
+    restored = restore_pytree(path, plike)
+    restored[MASKS_KEY] = unpack_mask_tree(restored.pop(MASKS_PACKED_KEY))
+    return restored
+
+
 class ExperimentCheckpoints:
     """Role-addressed checkpoints under an experiment directory (the
     reference's checkpoints/ + artifacts/ split, harness_utils.py:90-93)."""
@@ -137,16 +239,20 @@ class ExperimentCheckpoints:
         }
 
     def save_model(self, role: str, state) -> None:
-        save_pytree(self.model_path(role), self.model_state(state))
+        save_model_tree(self.model_path(role), self.model_state(state))
 
     def load_model(self, role: str, like_state) -> dict:
-        return restore_pytree(self.model_path(role), self.model_state(like_state))
+        return restore_model_tree(
+            self.model_path(role), self.model_state(like_state)
+        )
 
     def save_level(self, level: int, state) -> None:
-        save_pytree(self.level_path(level), self.model_state(state))
+        save_model_tree(self.level_path(level), self.model_state(state))
 
     def load_level(self, level: int, like_state) -> dict:
-        return restore_pytree(self.level_path(level), self.model_state(like_state))
+        return restore_model_tree(
+            self.level_path(level), self.model_state(like_state)
+        )
 
     def has_model(self, role: str) -> bool:
         return self.model_path(role).exists()
@@ -187,7 +293,7 @@ class ExperimentCheckpoints:
         # the harness falls back to replaying the level — never a mixed
         # old-header/new-state restore.
         tag = level * 1_000_000 + epoch  # int: Orbax round-trips it exactly
-        save_pytree(
+        save_model_tree(
             self.mid_level_path(),
             {
                 "params": state.params,
@@ -223,7 +329,7 @@ class ExperimentCheckpoints:
         """Restore the slot; returns the state dict, or None when the slot's
         embedded tag disagrees with the header-derived expectation (a torn
         save — the caller must replay the level from its start)."""
-        restored = restore_pytree(
+        restored = restore_model_tree(
             self.mid_level_path(),
             {
                 "params": like_state.params,
