@@ -491,6 +491,43 @@ def bench_serving() -> dict:
 
 
 # ------------------------------------------------------------ compaction
+def _tree_leaf(tree, path):
+    for k in path:
+        tree = tree[k]
+    return tree
+
+
+def _channel_structured_masks(params, graph, kill_frac: float):
+    """Kill the kill_frac smallest-L2 fan-out slices of every compactable
+    space; everything else stays dense. The channel structure compaction
+    needs — scattered unstructured zeros would compact to nothing."""
+    from turboprune_tpu.ops import masking
+
+    masks = jax.tree.map(
+        lambda m: None if m is None else np.array(m),
+        masking.make_masks(params),
+        is_leaf=lambda v: v is None,
+    )
+    for sp in graph.spaces.values():
+        node = masks
+        for k in sp.producer.kernel[:-1]:
+            node = node[k]
+        kernel = np.asarray(
+            jax.device_get(_tree_leaf(params, sp.producer.kernel)),
+            np.float32,
+        )
+        norms = np.sqrt(
+            (kernel.reshape(-1, kernel.shape[-1]) ** 2).sum(axis=0)
+        )
+        order = np.argsort(norms)
+        m = node[sp.producer.kernel[-1]]
+        m[..., order[: int(len(order) * kill_frac)]] = False
+    return jax.tree.map(
+        lambda m: None if m is None else jnp.asarray(m), masks,
+        is_leaf=lambda v: v is None,
+    )
+
+
 def bench_compaction() -> dict:
     """Dead-channel compaction payoff (sparse/): masked-dense vs compacted
     eval throughput across sparsity levels, plus the parity max-abs-diff.
@@ -531,41 +568,9 @@ def bench_compaction() -> dict:
             best = min(best, (time.perf_counter() - t0) / 5)
         return best
 
-    def channel_masks(kill_frac: float):
-        """Kill the kill_frac smallest-L2 fan-out slices of every
-        compactable space; everything else stays dense."""
-        masks = jax.tree.map(
-            lambda m: None if m is None else np.array(m),
-            masking.make_masks(params),
-            is_leaf=lambda v: v is None,
-        )
-        for sp in graph.spaces.values():
-            node = masks
-            for k in sp.producer.kernel[:-1]:
-                node = node[k]
-            kernel = np.asarray(
-                jax.device_get(_tree_leaf(params, sp.producer.kernel)),
-                np.float32,
-            )
-            norms = np.sqrt(
-                (kernel.reshape(-1, kernel.shape[-1]) ** 2).sum(axis=0)
-            )
-            order = np.argsort(norms)
-            m = node[sp.producer.kernel[-1]]
-            m[..., order[: int(len(order) * kill_frac)]] = False
-        return jax.tree.map(
-            lambda m: None if m is None else jnp.asarray(m), masks,
-            is_leaf=lambda v: v is None,
-        )
-
-    def _tree_leaf(tree, path):
-        for k in path:
-            tree = tree[k]
-        return tree
-
     fields: dict = {"compaction_model": "vgg16_bn", "compaction_batch": batch}
     for frac in (0.5, 0.75, 0.9):
-        masks = channel_masks(frac)
+        masks = _channel_structured_masks(params, graph, frac)
         sparsity = masking.overall_sparsity(masks)
 
         def dense_fwd(p, xx, masks=masks):
@@ -614,6 +619,127 @@ def bench_compaction() -> dict:
         fields[f"{tag}_channels_after"] = res.report["channels_after"]
     fields["compaction_params_dense"] = res.report["params_before"]
     fields["compaction_channels_dense"] = res.report["channels_before"]
+    return fields
+
+
+# -------------------------------------------------------- compact train
+def bench_compact_train() -> dict:
+    """Compact-as-you-train payoff (sparse/train_compact.py + the harness's
+    compact_train path): per-step TRAIN time — fwd+bwd+update — of the
+    masked-dense model vs the physically re-instantiated small one at
+    90/95% channel-structured sparsity, plus the full-coordinate round-trip
+    parity of one train step (compact -> step -> expand vs the dense step
+    from the identical start state).
+
+    SGD+momentum with weight_decay=0 — the regime where the round trip is
+    exact: a fully-masked coordinate sees zero data-gradient and fresh zero
+    momentum, so the dense run never moves it and the anchor-restored value
+    matches (README "Sparsity execution"). Kept-coordinate diffs are pure
+    XLA reassociation noise, reported honestly as the measured max.
+    Dropout is DISABLED for the parity leg: per-unit dropout draws cannot
+    align across differently-shaped hidden axes, so with it on the diff
+    measures dropout sampling, not the round trip (the same caveat the
+    README documents for compact training of dropout models)."""
+    from turboprune_tpu.models.vgg import VGG, VGG_CFGS
+    from turboprune_tpu.ops import masking
+    from turboprune_tpu.sparse import (
+        build_graph,
+        build_plan,
+        compact_train_state,
+        expand_train_state,
+    )
+    from turboprune_tpu.train import (
+        create_optimizer,
+        create_train_state,
+        make_train_step,
+    )
+
+    batch = 32
+    model = VGG(
+        VGG_CFGS["vgg16"], 1000, batch_norm=True, dtype=jnp.bfloat16,
+        dropout_rate=0.0,
+    )
+    tx = create_optimizer("SGD", 0.05, momentum=0.9, weight_decay=0.0)
+    # graftlint: disable=rng-key-reuse -- fixed seed on purpose: identical weights every bench round
+    init_key = jax.random.PRNGKey(0)
+    state0 = create_train_state(model, tx, init_key, (1, 224, 224, 3))
+    graph = build_graph(model, state0.params)
+    rng = np.random.default_rng(0)
+    batch_data = (
+        jnp.asarray(rng.standard_normal((batch, 224, 224, 3)).astype(np.float32)),
+        jnp.asarray(rng.integers(0, 1000, size=(batch,)).astype(np.int32)),
+    )
+
+    def timed_step(step, st) -> float:
+        out, _ = step(st, batch_data)
+        jax.block_until_ready(out.params)  # compile + sync
+        best = float("inf")
+        for _ in range(3):
+            cur = st
+            t0 = time.perf_counter()
+            for _ in range(5):
+                cur, _ = step(cur, batch_data)
+            jax.block_until_ready(cur.params)
+            best = min(best, (time.perf_counter() - t0) / 5)
+        return best
+
+    fields: dict = {
+        "compact_train_model": "vgg16_bn",
+        "compact_train_batch": batch,
+    }
+    plan = None
+    for frac in (0.9, 0.95):
+        masks = _channel_structured_masks(state0.params, graph, frac)
+        st = state0.replace(masks=masks, opt_state=tx.init(state0.params))
+        sparsity = masking.overall_sparsity(masks)
+
+        # Each sparsity level IS a new program (masks close over the dense
+        # step via the state, the compacted model has different shapes) —
+        # one compile per level is the thing being measured; both
+        # executables are reused for the timing loops and the parity diff.
+        # graftlint: disable=retrace-hazard -- one jit per sparsity level by design: widths differ per iteration, executable reused for timing + parity
+        dense_step = jax.jit(make_train_step(model, tx))
+        dense_t = timed_step(dense_step, st)
+
+        plan = build_plan(st.params, st.masks, graph, st.batch_stats)
+        small_model = VGG(
+            VGG_CFGS["vgg16"], 1000, batch_norm=True, dtype=jnp.bfloat16,
+            dropout_rate=0.0,
+            width_overrides=tuple(sorted(plan.width_overrides.items())),
+        )
+        # graftlint: disable=retrace-hazard -- one jit per sparsity level by design: the compacted model changes shape per iteration
+        small_step = jax.jit(make_train_step(small_model, tx))
+        small_st = compact_train_state(st, plan)
+        small_t = timed_step(small_step, small_st)
+
+        # One-step round trip, compared in FULL coordinates.
+        dense_after, _ = dense_step(st, batch_data)
+        small_after, _ = small_step(small_st, batch_data)
+        restored = expand_train_state(small_after, plan, anchor=st)
+        diff = max(
+            jax.tree.leaves(
+                jax.tree.map(
+                    lambda a, b: float(
+                        jnp.max(
+                            jnp.abs(
+                                jnp.asarray(a, jnp.float32)
+                                - jnp.asarray(b, jnp.float32)
+                            )
+                        )
+                    ),
+                    dense_after.params,
+                    restored.params,
+                )
+            )
+        )
+        tag = f"compact_train_s{int(round(sparsity))}"
+        fields[f"{tag}_sparsity_pct"] = round(sparsity, 2)
+        fields[f"{tag}_dense_step_ms"] = round(dense_t * 1e3, 2)
+        fields[f"{tag}_compacted_step_ms"] = round(small_t * 1e3, 2)
+        fields[f"{tag}_speedup"] = round(dense_t / small_t, 3)
+        fields[f"{tag}_roundtrip_parity_max_abs_diff"] = diff
+        fields[f"{tag}_params_after"] = plan.report["params_after"]
+    fields["compact_train_params_dense"] = plan.report["params_before"]
     return fields
 
 
@@ -858,7 +984,7 @@ def main() -> None:
     # tunnel must not stop the HOST-ONLY decode stages from caching.
     device_stages = {
         "resnet18", "resnet50", "flash_attention", "fed_resnet50",
-        "scan_chunk_sweep", "serving", "compaction",
+        "scan_chunk_sweep", "serving", "compaction", "compact_train",
     }
     if not force and all(s in cache for s in device_stages):
         tpu_ok = True  # everything device-side is already cached
@@ -958,6 +1084,7 @@ def main() -> None:
     run_device_stage("scan_chunk_sweep", stage_scan_chunk)
     run_device_stage("serving", bench_serving)
     run_device_stage("compaction", bench_compaction)
+    run_device_stage("compact_train", bench_compact_train)
     extra["pipeline_host_cpu_cores"] = os.cpu_count()
 
     _partial["done"] = True  # fire() checks this — cancel can lose the race

@@ -7,7 +7,13 @@
 #      conf/ <-> schema cross-checks. This is the real gate; it is the
 #      same invocation tests/test_analysis.py's self-gate pins at zero
 #      unwaived findings and zero stale waivers.
-#   3. tier-1 fast tests        — the same command ROADMAP.md pins,
+#   3. compact-train smoke      — the end-to-end harness lifecycle on
+#      synthetic .tpk data: 3 IMP levels, asserts the second level
+#      re-instantiates physically smaller, round-trips exactly back to
+#      full coordinates, eval parity holds across the exit expansion,
+#      and the per-width caches evict. Isolated stage so a compaction
+#      regression is named before the full suite runs.
+#   4. tier-1 fast tests        — the same command ROADMAP.md pins,
 #      including its plugin surface (-p no:xdist -p no:randomly), so the
 #      gate and tier-1 agree on what "the suite" is.
 # Exits nonzero if any stage fails. Run from anywhere: paths resolve
@@ -20,6 +26,11 @@ python -m turboprune_tpu.analysis --changed
 
 echo "== graftlint --project (interprocedural + config rules) =="
 python -m turboprune_tpu.analysis --project turboprune_tpu conf tests
+
+echo "== compact-train smoke (harness lifecycle on synthetic .tpk) =="
+JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_compact_train.py::TestHarnessCompactTrainSmoke -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly
 
 echo "== tier-1 tests (fast tier, CPU) =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

@@ -216,6 +216,27 @@ FIXTURES = {
             return y
         """,
     ),
+    "unhashable-width-overrides": (
+        """
+        def rebuild(model_cls, plan):
+            ov = {name: int(n) for name, n in plan.width_overrides.items()}
+            direct = model_cls(width_overrides={"conv1": 8})
+            via_name = model_cls(width_overrides=ov)
+            return direct, via_name
+        """,
+        """
+        from turboprune_tpu.models import create_model
+
+        def rebuild(model_cls, plan):
+            ov = {name: int(n) for name, n in plan.width_overrides.items()}
+            ov = tuple(sorted(ov.items()))
+            normalized = model_cls(width_overrides=ov)
+            # create_model normalizes a raw dict itself — the one callee
+            # a dict may flow into.
+            factory = create_model("vgg16", width_overrides={"conv1": 8})
+            return normalized, factory
+        """,
+    ),
 }
 
 

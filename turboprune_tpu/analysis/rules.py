@@ -1,4 +1,4 @@
-"""The graftlint rule set: eight JAX failure classes, tuned to this repo.
+"""The graftlint rule set: nine JAX failure classes, tuned to this repo.
 
 Every rule documents WHY its pattern matters on TPU, because the finding
 message is what a contributor sees at review time. Severities: "error" for
@@ -1066,3 +1066,97 @@ class DebugInHotPathRule(Rule):
                     msg = f"{name} inside jit-traced code"
                 if msg:
                     yield ctx.finding(self, node, msg)
+
+
+# ------------------------------------------ 9 unhashable-width-overrides
+
+
+def _is_dict_expr(node: ast.AST) -> bool:
+    return isinstance(node, (ast.Dict, ast.DictComp)) or (
+        isinstance(node, ast.Call) and dotted_name(node.func) == "dict"
+    )
+
+
+@register
+class UnhashableWidthOverridesRule(Rule):
+    """A dict passed as ``width_overrides=`` to anything but create_model.
+
+    Flax modules are frozen dataclasses and their HASH is the jit trace
+    cache key: a model built with ``width_overrides={...}`` constructs
+    fine, then raises ``TypeError: unhashable type: 'dict'`` at the first
+    jitted apply — far from the construction site, typically inside a
+    harness step function. The repo's convention is
+    ``tuple(sorted(d.items()))`` at the model boundary;
+    ``models.create_model`` performs that normalization itself and is the
+    one callee a raw dict may flow into. Tests are exempt: the fixture
+    models there pin the normalized form explicitly.
+    """
+
+    id = "unhashable-width-overrides"
+    severity = "warning"
+    skip_in_tests = True
+    description = (
+        "width_overrides passed as a dict to a model factory — flax "
+        "Modules hash into the jit cache, so the dict detonates at first "
+        "traced apply; normalize with tuple(sorted(d.items())) or go "
+        "through create_model"
+    )
+
+    # create_model normalizes a raw dict itself; the sparse plan/result
+    # containers hold the dict by DESIGN (host-side bookkeeping — their
+    # as_override_tuple() is the hashable model boundary).
+    _ALLOWED_CALLEES = {"create_model", "CompactionPlan", "CompactionResult"}
+
+    def check(self, ctx: ModuleContext) -> Iterator:
+        for _scope, body, _params in _function_scopes(ctx.tree):
+            # Most recent binding per name, in source order: a name counts
+            # as dict-valued at a call site only if its LAST assignment
+            # before that line was a dict display/comp/dict() call — so
+            # the normalize-then-pass idiom stays silent.
+            bindings: list = []  # (lineno, name, is_dict)
+            calls: list = []
+            for node in _walk_no_nested_defs(body):
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        for name in _target_names(t):
+                            bindings.append(
+                                (node.lineno, name, _is_dict_expr(node.value))
+                            )
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    name = dotted_name(node.target)
+                    if name:
+                        bindings.append(
+                            (node.lineno, name, _is_dict_expr(node.value))
+                        )
+                elif isinstance(node, ast.Call):
+                    calls.append(node)
+
+            for call in calls:
+                if _tail(dotted_name(call.func)) in self._ALLOWED_CALLEES:
+                    continue
+                for kw in call.keywords:
+                    if kw.arg != "width_overrides":
+                        continue
+                    value = kw.value
+                    verdict = None
+                    if _is_dict_expr(value):
+                        verdict = "a dict literal"
+                    elif isinstance(value, ast.Name):
+                        prior = [
+                            b
+                            for b in bindings
+                            if b[1] == value.id and b[0] <= call.lineno
+                        ]
+                        if prior and max(prior)[2]:
+                            verdict = f"'{value.id}', last assigned a dict"
+                    if verdict:
+                        yield ctx.finding(
+                            self,
+                            call,
+                            f"width_overrides receives {verdict} — flax "
+                            "Modules are hashed into the jit trace cache, "
+                            "so this raises TypeError: unhashable at the "
+                            "first jitted apply; pass "
+                            "tuple(sorted(d.items())) (create_model "
+                            "normalizes internally and is exempt)",
+                        )

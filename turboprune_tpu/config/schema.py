@@ -253,6 +253,17 @@ class ExperimentConfig:
     # Numerically equivalent up to fp reassociation; the per-level
     # compaction report lands on harness.last_compaction_report.
     compact_eval: bool = False
+    # Compact-as-you-train (sparse/train_compact.py): when a level's masks
+    # contain enough dead channels, slice the WHOLE train state, rebuild
+    # the model at the smaller widths, and run the level's epochs on the
+    # physically smaller program — expanding back to full coordinates
+    # before pruning, rewind saves and checkpoints (README "Sparsity
+    # execution"). Ignored for levels below the savings threshold.
+    compact_train: bool = False
+    # Minimum fraction of parameters the slicing must remove before a
+    # level is re-instantiated small (compile + state-slice overhead must
+    # be worth it). 0 re-instantiates on any nonzero shrinkage.
+    compact_min_savings: float = 0.25
 
     def validate(self) -> None:
         _check_choice(
@@ -264,6 +275,8 @@ class ExperimentConfig:
             raise ConfigError("model_parallelism must be >= 1")
         if self.checkpoint_every_epochs < 0:
             raise ConfigError("checkpoint_every_epochs must be >= 0")
+        if not (0.0 <= self.compact_min_savings < 1.0):
+            raise ConfigError("compact_min_savings must be in [0, 1)")
 
 
 @dataclass

@@ -58,31 +58,42 @@ class CyclicPruningHarness(PruningHarness):
         max_test_acc = 0.0
         for cycle, epochs in enumerate(cycle_epochs):
             # Fresh optimizer + schedule per cycle: the LR re-warms from the
-            # schedule's start (cyclic_harness.py:180-194).
+            # schedule's start (cyclic_harness.py:180-194). setup_level
+            # re-inits the optimizer from FULL params, so compact training
+            # enters/exits per cycle — the small step bundle is cached by
+            # (total_steps, widths) and cycles with equal epoch budgets
+            # reuse one executable.
             self.setup_level(epochs)
             if cycle == 0:
                 self.maybe_rewind_optimizer(level)
-            for epoch in range(epochs):
-                row = {"level": level, "cycle": cycle, "epoch": epoch}
-                row.update(self.train_epoch())
-                row.update(self.evaluate())
-                max_test_acc = max(max_test_acc, row["test_acc"])
-                row["max_test_acc"] = max_test_acc
-                row["sparsity"] = masking.overall_sparsity(self.state.masks)
-                self.metrics.log_epoch(row)
-                self.wandb.log(row)
-                self._log_console(row)
-
-                if (
-                    level == 0
-                    and cycle == 0
-                    and rewind_epoch is not None
-                    and epoch == rewind_epoch
-                ):
-                    self.ckpts.save_model(MODEL_REWIND, self.state)
-                    self.ckpts.save_optimizer(
-                        OPTIMIZER_REWIND, self.state.opt_state
+            self._maybe_enter_compact_train()
+            try:
+                for epoch in range(epochs):
+                    row = {"level": level, "cycle": cycle, "epoch": epoch}
+                    row.update(self.train_epoch())
+                    row.update(self.evaluate())
+                    max_test_acc = max(max_test_acc, row["test_acc"])
+                    row["max_test_acc"] = max_test_acc
+                    row["sparsity"] = masking.overall_sparsity(
+                        self._full_masks()
                     )
+                    self.metrics.log_epoch(row)
+                    self.wandb.log(row)
+                    self._log_console(row)
+
+                    if (
+                        level == 0
+                        and cycle == 0
+                        and rewind_epoch is not None
+                        and epoch == rewind_epoch
+                    ):
+                        full = self._full_state()
+                        self.ckpts.save_model(MODEL_REWIND, full)
+                        self.ckpts.save_optimizer(
+                            OPTIMIZER_REWIND, full.opt_state
+                        )
+            finally:
+                self._exit_compact_train()
 
         return self.metrics.finish_level(
             level,

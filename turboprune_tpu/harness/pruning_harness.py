@@ -40,6 +40,7 @@ from ..ops import masking
 from ..parallel import (
     assemble_batch,
     assemble_chunk,
+    assert_width_agreement,
     create_mesh,
     is_primary,
     epoch_sharding,
@@ -179,6 +180,19 @@ class PruningHarness:
         # reuse one executable.
         self._compact_eval_cache: dict[tuple, Any] = {}
         self.last_compaction_report: Optional[dict] = None
+        # Opt-in compact TRAINING (experiment_params.compact_train): once a
+        # level's dead-channel savings clear compact_min_savings, the whole
+        # level trains on a physically re-instantiated smaller model. The
+        # per-width step bundle is cached by (total_steps, width signature);
+        # _compact_ctx holds the plan + the full-coordinate anchor while the
+        # small run is live (None <=> training dense). Cache sizes and the
+        # last compaction report are exported on compact_metrics so the
+        # bench/tests can read the size the level ACTUALLY compiled.
+        self._compact_step_cache: dict[tuple, tuple] = {}
+        self._compact_ctx: Optional[dict] = None
+        from ..serve.metrics import ServeMetrics
+
+        self.compact_metrics = ServeMetrics()
 
     # ------------------------------------------------------------------ tx
     def _build_tx(self, epochs: int):
@@ -204,6 +218,7 @@ class PruningHarness:
         standard_pruning_harness.py:174-175). Reuses the compiled step when
         the epoch budget (=> schedule constants) is unchanged."""
         total_steps = epochs * self.steps_per_epoch
+        self._current_epochs = epochs  # compact path rebuilds the same tx
         if total_steps not in self._step_cache:
             tx, schedule = self._build_tx(epochs)
             raw_step = make_train_step(self.model, tx, schedule)
@@ -332,7 +347,10 @@ class PruningHarness:
             ev_state = ev_state.replace(
                 params=eval_params(ev_state.opt_state, ev_state.params)
             )
-        if self.cfg.experiment_params.compact_eval:
+        if self.cfg.experiment_params.compact_eval and self._compact_ctx is None:
+            # With compact TRAINING live the state is already small and
+            # _eval_step/_scan_eval are the small model's — re-compacting
+            # sliced params against the full model's graph would be wrong.
             return self._evaluate_compacted(ev_state)
         test_loader = self.loaders.test_loader
         if hasattr(test_loader, "eval_epoch_arrays"):
@@ -379,20 +397,11 @@ class PruningHarness:
         self.last_compaction_report = res.report
         key = res.as_override_tuple()
         if key not in self._compact_eval_cache:
-            attention_impl = self.cfg.model_params.attention_impl
-            if attention_impl == "ring":
-                attention_impl = "dense"
-            small_model = create_model(
-                self.cfg.model_params.model_name,
-                num_classes=self.cfg.dataset_params.num_classes,
-                dataset_name=self.cfg.dataset_params.dataset_name,
-                compute_dtype=self.compute_dtype,
-                attention_impl=attention_impl,
-                width_overrides=res.width_overrides,
-            )
+            self._evict_stale_compact_caches(key)
             self._compact_eval_cache[key] = jax.jit(
-                make_eval_step(small_model)
+                make_eval_step(self._small_model(res.width_overrides))
             )
+            self._export_cache_gauges()
         step = self._compact_eval_cache[key]
         # make_eval_step multiplies masks into params; all-ones masks on
         # the compacted (already folded) params make that an exact no-op,
@@ -417,6 +426,175 @@ class PruningHarness:
             "test_loss": float(sums["loss_sum"]) / n,
             "test_acc": 100.0 * float(sums["correct"]) / n,
         }
+
+    # ------------------------------------------------------- compact train
+    def _small_model(self, width_overrides):
+        """Re-instantiate the architecture at compacted widths. Ring
+        attention falls back to its param-identical dense equivalent (as in
+        serving): the small model is replicated, not sequence-sharded."""
+        attention_impl = self.cfg.model_params.attention_impl
+        if attention_impl == "ring":
+            attention_impl = "dense"
+        return create_model(
+            self.cfg.model_params.model_name,
+            num_classes=self.cfg.dataset_params.num_classes,
+            dataset_name=self.cfg.dataset_params.dataset_name,
+            compute_dtype=self.compute_dtype,
+            attention_impl=attention_impl,
+            mesh=self.mesh,
+            width_overrides=width_overrides,
+        )
+
+    def _maybe_enter_compact_train(self) -> None:
+        """Swap the level onto a physically smaller model when the masks'
+        dead-channel savings clear ``compact_min_savings``.
+
+        The FULL state at entry is kept as the anchor: at exit (and for any
+        checkpoint written mid-level) the trained small state is scattered
+        back over it, so removed coordinates — including consumer in-rows
+        of dead channels, whose real magnitudes the next level's GLOBAL
+        threshold must still see — come back exactly as the dense run would
+        have left them (exact for weight_decay=0 with the per-level fresh
+        optimizer; a removed coordinate then sees zero gradient and zero
+        momentum, i.e. it never moves)."""
+        ep = self.cfg.experiment_params
+        if not ep.compact_train or self._compact_ctx is not None:
+            return
+        from ..sparse import (
+            CompactionError,
+            build_graph,
+            build_plan,
+            compact_train_state,
+            width_signature,
+        )
+
+        plan = None
+        commit = False
+        sig: dict = {"commit": False}
+        try:
+            graph = build_graph(self.model, self.state.params)
+            plan = build_plan(
+                self.state.params, self.state.masks, graph, self.state.batch_stats
+            )
+            commit = plan.savings() >= ep.compact_min_savings
+            if commit:
+                sig = {"commit": True, "widths": width_signature(plan)}
+        except CompactionError as e:
+            # Un-compactable masks (e.g. a zero-width space): train dense.
+            sig = {"commit": False, "error": str(e)}
+        # Collective — every process must reach this call, with its decision
+        # (including a failure) encoded in the signature; skipping it on one
+        # host would deadlock the others inside the allgather.
+        assert_width_agreement(sig)
+        if not commit:
+            return
+
+        total_steps = self._current_epochs * self.steps_per_epoch
+        width_key = plan.as_override_tuple()
+        key = (total_steps, width_key)
+        self._evict_stale_compact_caches(width_key)
+        if key not in self._compact_step_cache:
+            small_model = self._small_model(plan.width_overrides)
+            tx, schedule = self._build_tx(self._current_epochs)
+            raw_step = make_train_step(small_model, tx, schedule)
+            raw_eval = make_eval_step(small_model)
+            self._compact_step_cache[key] = (
+                make_sharded_train_step(raw_step, self.mesh),
+                make_sharded_scan_epoch(make_scan_epoch(raw_step), self.mesh),
+                make_sharded_scan_chunk(make_scan_chunk(raw_step), self.mesh),
+                make_sharded_eval_step(raw_eval, self.mesh),
+                make_sharded_scan_eval(make_scan_eval(raw_eval), self.mesh),
+            )
+        self._export_cache_gauges()
+        self._compact_ctx = {
+            "plan": plan,
+            "anchor": self.state,
+            "dense_fns": (
+                self._train_step,
+                self._scan_epoch,
+                self._scan_chunk,
+                self._eval_step,
+                self._scan_eval,
+            ),
+        }
+        (
+            self._train_step,
+            self._scan_epoch,
+            self._scan_chunk,
+            self._eval_step,
+            self._scan_eval,
+        ) = self._compact_step_cache[key]
+        self.state = replicate(compact_train_state(self.state, plan), self.mesh)
+        self.last_compaction_report = plan.report
+        self.compact_metrics.record_compaction(plan.report)
+        if is_primary():
+            r = plan.report
+            print(
+                f"[compact-train] level runs physically small: params "
+                f"{r['params_before']:,} -> {r['params_after']:,}, channels "
+                f"{r['channels_before']:,} -> {r['channels_after']:,} "
+                f"({r['compacted_spaces']} spaces)",
+                flush=True,
+            )
+
+    def _exit_compact_train(self) -> None:
+        """Expand back to full coordinates and restore the dense step fns.
+        Idempotent; called in a finally so a raising epoch can't leave the
+        harness stuck small (the driver's save_level/prune always see full
+        coordinates)."""
+        if self._compact_ctx is None:
+            return
+        from ..sparse import expand_train_state
+
+        ctx = self._compact_ctx
+        self._compact_ctx = None
+        (
+            self._train_step,
+            self._scan_epoch,
+            self._scan_chunk,
+            self._eval_step,
+            self._scan_eval,
+        ) = ctx["dense_fns"]
+        self.state = replicate(
+            expand_train_state(self.state, ctx["plan"], anchor=ctx["anchor"]),
+            self.mesh,
+        )
+
+    def _full_state(self) -> TrainState:
+        """The live state in FULL coordinates — what every checkpoint
+        (rewind artifacts, mid-level slots) must hold so restores never
+        learn the level ran small."""
+        if self._compact_ctx is None:
+            return self.state
+        from ..sparse import expand_train_state
+
+        return expand_train_state(
+            self.state, self._compact_ctx["plan"], anchor=self._compact_ctx["anchor"]
+        )
+
+    def _full_masks(self):
+        """Full-coordinate masks for metric rows. Masks never change inside
+        a level, so while compacted the anchor's tree IS the current one."""
+        if self._compact_ctx is None:
+            return self.state.masks
+        return self._compact_ctx["anchor"].masks
+
+    def _evict_stale_compact_caches(self, width_key: tuple) -> None:
+        """Widths only shrink as the density ladder descends — executables
+        compiled for an older (wider) signature can never be hit again and
+        would pin dead HLO + donated buffers for the rest of the run."""
+        for k in [k for k in self._compact_step_cache if k[1] != width_key]:
+            del self._compact_step_cache[k]
+        for k in [k for k in self._compact_eval_cache if k != width_key]:
+            del self._compact_eval_cache[k]
+
+    def _export_cache_gauges(self) -> None:
+        self.compact_metrics.set_gauge(
+            "compact_train_cache_size", len(self._compact_step_cache)
+        )
+        self.compact_metrics.set_gauge(
+            "compact_eval_cache_size", len(self._compact_eval_cache)
+        )
 
     # --------------------------------------------------------------- level
     def train_one_level(self, epochs_per_level: int, level: int) -> dict:
@@ -500,61 +678,76 @@ class PruningHarness:
                         f"{level} at epoch {start_epoch}",
                         flush=True,
                     )
-        for epoch in range(start_epoch, epochs_per_level):
-            # Trace the second epoch of level 0 (first is compile-polluted).
-            tracing = bool(profile_dir) and level == 0 and epoch == 1
-            if tracing:
-                jax.profiler.start_trace(profile_dir)
-            row = {"level": level, "epoch": epoch}
-            row.update(self.train_epoch())
-            if tracing:
-                jax.profiler.stop_trace()
-            row.update(self.evaluate())
-            max_test_acc = max(max_test_acc, row["test_acc"])
-            row["max_test_acc"] = max_test_acc
-            row["sparsity"] = masking.overall_sparsity(self.state.masks)
-            self.metrics.log_epoch(row)
-            self.wandb.log(row)
-            self._log_console(row)
+        # After any mid-level restore, so the anchor is the true level-start
+        # full state (post-rewind, post-resume) and a resumed level re-enters
+        # compaction from the restored full coordinates.
+        self._maybe_enter_compact_train()
+        try:
+            for epoch in range(start_epoch, epochs_per_level):
+                # Trace the second epoch of level 0 (first is
+                # compile-polluted).
+                tracing = bool(profile_dir) and level == 0 and epoch == 1
+                if tracing:
+                    jax.profiler.start_trace(profile_dir)
+                row = {"level": level, "epoch": epoch}
+                row.update(self.train_epoch())
+                if tracing:
+                    jax.profiler.stop_trace()
+                row.update(self.evaluate())
+                max_test_acc = max(max_test_acc, row["test_acc"])
+                row["max_test_acc"] = max_test_acc
+                row["sparsity"] = masking.overall_sparsity(self._full_masks())
+                self.metrics.log_epoch(row)
+                self.wandb.log(row)
+                self._log_console(row)
 
-            if level == 0 and rewind_epoch is not None and epoch == rewind_epoch:
-                # Weight-rewinding snapshot (standard_pruning_harness.py:
-                # 212-223).
-                self.ckpts.save_model(MODEL_REWIND, self.state)
-                self.ckpts.save_optimizer(OPTIMIZER_REWIND, self.state.opt_state)
+                if level == 0 and rewind_epoch is not None and epoch == rewind_epoch:
+                    # Weight-rewinding snapshot (standard_pruning_harness.py:
+                    # 212-223). Full coordinates — the rewind target must
+                    # not depend on whether this level ran compacted.
+                    full = self._full_state()
+                    self.ckpts.save_model(MODEL_REWIND, full)
+                    self.ckpts.save_optimizer(OPTIMIZER_REWIND, full.opt_state)
 
-            if (
-                ckpt_every
-                and (epoch + 1) % ckpt_every == 0
-                and epoch + 1 < epochs_per_level  # last epoch -> level ckpt
-            ):
-                meta = {
-                    "max_test_acc": max_test_acc,
-                    # Slot identity (ADVICE r5): the restore path refuses a
-                    # slot whose config hash disagrees with the live run.
-                    "config_hash": self.config_hash,
-                    "run_id": self.run_id,
-                    "train_loader_epoch": getattr(
-                        self.loaders.train_loader, "epoch", 0
-                    ),
-                    # So the level CSV / summary survive the preemption
-                    # (rows are plain float/int dicts — JSON-safe).
-                    "level_rows": self.metrics.level_rows,
-                }
-                get_stream = getattr(
-                    self.loaders.train_loader, "get_stream_state", None
-                )
-                if get_stream is not None:
-                    stream = get_stream()
-                    if stream is not None:
-                        # EVERY host writes its own blob (its own shard
-                        # position) — a shared primary-only header would
-                        # hand all hosts the primary's position.
-                        self.ckpts.save_mid_level_stream(
-                            level, epoch, stream, jax.process_index()
-                        )
-                        meta["train_loader_stream_hosts"] = jax.process_count()
-                self.ckpts.save_mid_level(level, epoch, self.state, meta=meta)
+                if (
+                    ckpt_every
+                    and (epoch + 1) % ckpt_every == 0
+                    and epoch + 1 < epochs_per_level  # last epoch -> level ckpt
+                ):
+                    meta = {
+                        "max_test_acc": max_test_acc,
+                        # Slot identity (ADVICE r5): the restore path refuses
+                        # a slot whose config hash disagrees with the live
+                        # run.
+                        "config_hash": self.config_hash,
+                        "run_id": self.run_id,
+                        "train_loader_epoch": getattr(
+                            self.loaders.train_loader, "epoch", 0
+                        ),
+                        # So the level CSV / summary survive the preemption
+                        # (rows are plain float/int dicts — JSON-safe).
+                        "level_rows": self.metrics.level_rows,
+                    }
+                    get_stream = getattr(
+                        self.loaders.train_loader, "get_stream_state", None
+                    )
+                    if get_stream is not None:
+                        stream = get_stream()
+                        if stream is not None:
+                            # EVERY host writes its own blob (its own shard
+                            # position) — a shared primary-only header would
+                            # hand all hosts the primary's position.
+                            self.ckpts.save_mid_level_stream(
+                                level, epoch, stream, jax.process_index()
+                            )
+                            meta["train_loader_stream_hosts"] = (
+                                jax.process_count()
+                            )
+                    self.ckpts.save_mid_level(
+                        level, epoch, self._full_state(), meta=meta
+                    )
+        finally:
+            self._exit_compact_train()
 
         return self.metrics.finish_level(
             level,

@@ -212,6 +212,36 @@ def check_state_equality(
                 )
 
 
+def assert_width_agreement(signature: Any, what: str = "compact-train") -> None:
+    """Assert every process derived the SAME compaction decision before any
+    re-instantiation happens; raises on divergence.
+
+    ``signature`` is any JSON-serializable encoding of the decision — the
+    harness passes ``{"commit": bool, "widths": [[space, kept], ...]}``.
+    Masks are replicated, so agreement is guaranteed by construction; this
+    assertion exists because the failure mode it guards — replicas
+    compiling DIFFERENT small-model shapes and then deadlocking inside a
+    collective with mismatched buffer sizes — is near-undebuggable when it
+    happens, while a digest allgather per level is free. Every process must
+    call this (it is itself a collective); encode skip decisions in the
+    signature rather than skipping the call."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    payload = json.dumps(signature, sort_keys=True).encode()
+    digest = np.frombuffer(hashlib.sha256(payload).digest(), dtype=np.uint8)
+    all_d = np.asarray(multihost_utils.process_allgather(digest, tiled=False))
+    for i, other in enumerate(all_d):
+        if not np.array_equal(all_d[0], other):
+            raise RuntimeError(
+                f"{what} width signature diverged across hosts: host 0 != "
+                f"host {i} (this host's signature: {signature!r}). "
+                "Re-instantiating would compile divergent shapes; replicated "
+                "pruning requires identical masks on every host."
+            )
+
+
 def sync_hosts(name: str = "barrier") -> None:
     """Cross-host barrier (reference dist.barrier, distributed_utils.py:27)."""
     if jax.process_count() > 1:

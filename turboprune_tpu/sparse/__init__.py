@@ -1,26 +1,61 @@
-"""Make sparsity pay: dead-channel compaction for eval/serving.
+"""Make sparsity pay: dead-channel compaction for train, eval and serving.
 
-graph.py    mask-structure analysis — channel spaces with per-architecture
-            propagation (VGG chains, ResNet stops at residual joins,
-            DenseNet concat-aware offsets, ViT MLP blocks)
-compact.py  ``compact_params`` — physically slice dead channels out of
-            params/bias/BN leaves, returning smaller dense tensors + the
-            ``width_overrides`` needed to re-instantiate the model, with a
-            numeric-residue guard that keeps any dead channel whose
-            relu(bn(0)) constant is nonzero (exactness over size)
+graph.py          mask-structure analysis — channel spaces with
+                  per-architecture propagation (VGG chains, ResNet stops at
+                  residual joins, DenseNet concat-aware offsets, ViT MLP
+                  blocks)
+compact.py        ``build_plan`` (keep vectors + shape report) and the
+                  generic ``compact_tree``/``expand_tree`` slice/scatter
+                  pair; ``compact_params`` — mask-folded smaller tensors +
+                  ``width_overrides`` for eval/serving, with a
+                  numeric-residue guard that keeps any dead channel whose
+                  relu(bn(0)) constant is nonzero (exactness over size)
+train_compact.py  ``compact_train_state``/``expand_train_state`` — the
+                  WHOLE TrainState (raw params, masks, BN stats, optax
+                  moments) sliced for compact-as-you-train and scattered
+                  back to full coordinates for pruning/rewind/checkpoints
 
 Consumed by serve/engine.py (``compact: true`` load path), the harness's
-opt-in compacted eval, and bench.py's ``compaction`` stage.
+compact eval AND compact train paths, and bench.py's ``compaction`` /
+``compact_train`` stages.
 """
 
-from .compact import CompactionResult, analyze_masks, compact_params
+from .compact import (
+    CompactionPlan,
+    CompactionResult,
+    analyze_masks,
+    build_plan,
+    compact_params,
+    compact_stats,
+    compact_tree,
+    expand_stats,
+    expand_tree,
+)
 from .graph import CompactionError, PropagationGraph, build_graph
+from .train_compact import (
+    compact_train_state,
+    expand_opt_state,
+    expand_train_state,
+    slice_opt_state,
+    width_signature,
+)
 
 __all__ = [
     "CompactionError",
+    "CompactionPlan",
     "CompactionResult",
     "PropagationGraph",
     "analyze_masks",
     "build_graph",
+    "build_plan",
     "compact_params",
+    "compact_stats",
+    "compact_tree",
+    "compact_train_state",
+    "expand_opt_state",
+    "expand_stats",
+    "expand_train_state",
+    "expand_tree",
+    "slice_opt_state",
+    "width_signature",
 ]

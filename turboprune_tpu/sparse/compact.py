@@ -60,6 +60,32 @@ class CompactionResult:
         return tuple(sorted(self.width_overrides.items()))
 
 
+@dataclass
+class CompactionPlan:
+    """The reusable half of a compaction: which coordinates survive.
+
+    ``compact_params`` consumes one internally; compact-as-you-train keeps
+    one alive for a whole level so params, masks, batch_stats and optimizer
+    moments can all be sliced (and later expanded) with the SAME keep
+    vectors — the invariant that makes the round-trip exact."""
+
+    keeps: dict[str, np.ndarray]              # space name -> channel keep
+    out_keep: dict[PathT, np.ndarray]         # leaf path -> out-axis keep
+    in_keep: dict[PathT, np.ndarray]          # kernel path -> in-axis keep
+    stats_keep: dict[PathT, np.ndarray]       # BN stats leaf -> keep
+    width_overrides: dict                     # override_key -> kept channels
+    report: dict
+
+    def as_override_tuple(self) -> tuple:
+        """Hashable form for flax Module fields / cache keys."""
+        return tuple(sorted(self.width_overrides.items()))
+
+    def savings(self) -> float:
+        """Fraction of parameters removed by slicing (0 = identity)."""
+        before = self.report["params_before"]
+        return 1.0 - self.report["params_after"] / max(before, 1)
+
+
 # ------------------------------------------------------------------ helpers
 def _np(leaf) -> np.ndarray:
     return np.asarray(jax.device_get(leaf))
@@ -167,19 +193,18 @@ def analyze_masks(
 
 
 # --------------------------------------------------------------- compaction
-def compact_params(
+def build_plan(
     params: Any,
     masks: Any,
     graph: PropagationGraph,
     batch_stats: Optional[Any] = None,
-) -> CompactionResult:
-    """Slice dead channels out of params/batch_stats along the graph.
+) -> CompactionPlan:
+    """Analyze the masks once and freeze the slice geometry into a plan.
 
-    Returns mask-folded, physically smaller tensors plus the
-    ``width_overrides`` mapping that re-instantiates the matching model.
-    Leaves not named by the graph (trunk convs, attention projections,
-    classifier heads, frozen residual axes) are folded but keep their
-    shape."""
+    The plan is pure host-side bookkeeping (keep vectors + shape math for
+    the report) — no tensors are sliced here, so a harness can build one,
+    check ``plan.savings()`` against a threshold, and only then pay for
+    the actual state slicing."""
     batch_stats = batch_stats or {}
     keeps, report = analyze_masks(params, masks, graph, batch_stats)
 
@@ -206,41 +231,166 @@ def compact_params(
             keep = np.tile(keep, consumer.repeat)
         in_keep[consumer.kernel] = keep
 
-    folded = apply_masks(params, masks)
-
-    def slice_param(path: PathT, leaf):
-        arr = _np(leaf)
-        ik = in_keep.get(path)
-        if ik is not None:
-            arr = arr[..., ik, :]
-        ok = out_keep.get(path)
-        if ok is not None:
-            arr = arr[..., ok]
-        return arr
-
-    def slice_stat(path: PathT, leaf):
-        keep = stats_keep.get(path)
-        arr = _np(leaf)
-        return arr[..., keep] if keep is not None else arr
-
-    new_params = _map_leaves(folded, slice_param)
-    new_stats = _map_leaves(batch_stats, slice_stat) if batch_stats else {}
-
     width_overrides = {
         sp.override_key: int(keeps[name].sum())
         for name, sp in graph.spaces.items()
         if int(keeps[name].sum()) != sp.channels
     }
-    before = sum(int(np.size(_np(x))) for x in jax.tree.leaves(params))
-    after = sum(int(x.size) for x in jax.tree.leaves(new_params))
+
+    def sliced_numel(path: PathT, leaf) -> int:
+        shape = list(np.shape(leaf))
+        ik = in_keep.get(path)
+        if ik is not None:
+            shape[-2] = int(ik.sum())
+        ok = out_keep.get(path)
+        if ok is not None:
+            shape[-1] = int(ok.sum())
+        return int(np.prod(shape)) if shape else 1
+
+    before = after = 0
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        p = tuple(getattr(k, "key", k) for k in path)
+        before += int(np.size(leaf))
+        after += sliced_numel(p, leaf)
     report.update(
         params_before=before,
         params_after=after,
         compacted_spaces=len(width_overrides),
     )
+    return CompactionPlan(
+        keeps=keeps,
+        out_keep=out_keep,
+        in_keep=in_keep,
+        stats_keep=stats_keep,
+        width_overrides=width_overrides,
+        report=report,
+    )
+
+
+def _slice_leaf(arr: np.ndarray, ik, ok) -> np.ndarray:
+    if ik is not None:
+        arr = arr[..., ik, :]
+    if ok is not None:
+        arr = arr[..., ok]
+    return arr
+
+
+def _expand_leaf(arr: np.ndarray, ik, ok, base: Optional[np.ndarray] = None):
+    """Scatter a sliced leaf back into full coordinates.
+
+    Removed coordinates come from ``base`` (a full-coordinate anchor) when
+    given, else zeros — False for bool masks."""
+    if ik is None and ok is None:
+        return arr  # leaf untouched by the plan: trained values win
+    shape = list(arr.shape)
+    if ik is not None:
+        shape[-2] = int(ik.size)
+    if ok is not None:
+        shape[-1] = int(ok.size)
+    if base is not None:
+        out = np.array(_np(base))
+        if list(out.shape) != shape:
+            raise ValueError(
+                f"expand anchor shape {out.shape} != full shape {tuple(shape)}"
+            )
+    else:
+        out = np.zeros(shape, arr.dtype)
+    if ik is not None and ok is not None:
+        idx_in = np.where(ik)[0]
+        idx_out = np.where(ok)[0]
+        out[..., idx_in[:, None], idx_out[None, :]] = arr
+    elif ik is not None:
+        out[..., np.where(ik)[0], :] = arr
+    else:
+        out[..., np.where(ok)[0]] = arr
+    return out
+
+
+def compact_tree(tree: Any, plan: CompactionPlan) -> Any:
+    """Slice any params-structured pytree (raw/folded params, bool masks,
+    grads, an optimizer moment subtree) along the plan. None leaves (mask
+    tree at non-prunable positions) pass through."""
+
+    def fn(path: PathT, leaf):
+        if leaf is None:
+            return None
+        return _slice_leaf(
+            _np(leaf), plan.in_keep.get(path), plan.out_keep.get(path)
+        )
+
+    return _map_leaves(tree, fn)
+
+
+def expand_tree(
+    tree: Any, plan: CompactionPlan, anchor: Optional[Any] = None
+) -> Any:
+    """Inverse of ``compact_tree``: scatter back into full coordinates.
+
+    Kept coordinates are bit-identical to the sliced tree; removed
+    coordinates are zeros — or, with ``anchor`` (a full-coordinate tree of
+    the same structure), the anchor's values. The anchor form is what keeps
+    the next level's GLOBAL magnitude threshold honest: consumer in-rows of
+    a removed channel carry real (fully-masked-out or frozen) magnitudes in
+    a dense run, and zeroing them would change which weights the top-k
+    keeps."""
+
+    def fn(path: PathT, leaf):
+        if leaf is None:
+            return None
+        base = _tree_get(anchor, path) if anchor is not None else None
+        return _expand_leaf(
+            _np(leaf), plan.in_keep.get(path), plan.out_keep.get(path), base
+        )
+
+    return _map_leaves(tree, fn)
+
+
+def compact_stats(stats: Any, plan: CompactionPlan) -> Any:
+    """Slice BN running stats (mean/var leaves keyed by stats_keep)."""
+
+    def fn(path: PathT, leaf):
+        keep = plan.stats_keep.get(path)
+        arr = _np(leaf)
+        return arr[..., keep] if keep is not None else arr
+
+    return _map_leaves(stats, fn) if stats else {}
+
+
+def expand_stats(
+    stats: Any, plan: CompactionPlan, anchor: Optional[Any] = None
+) -> Any:
+    """Inverse of ``compact_stats``; removed entries from anchor or zeros."""
+
+    def fn(path: PathT, leaf):
+        keep = plan.stats_keep.get(path)
+        if keep is None:
+            return _np(leaf)
+        base = _tree_get(anchor, path) if anchor is not None else None
+        return _expand_leaf(_np(leaf), None, keep, base)
+
+    return _map_leaves(stats, fn) if stats else {}
+
+
+def compact_params(
+    params: Any,
+    masks: Any,
+    graph: PropagationGraph,
+    batch_stats: Optional[Any] = None,
+) -> CompactionResult:
+    """Slice dead channels out of params/batch_stats along the graph.
+
+    Returns mask-folded, physically smaller tensors plus the
+    ``width_overrides`` mapping that re-instantiates the matching model.
+    Leaves not named by the graph (trunk convs, attention projections,
+    classifier heads, frozen residual axes) are folded but keep their
+    shape."""
+    batch_stats = batch_stats or {}
+    plan = build_plan(params, masks, graph, batch_stats)
+    new_params = compact_tree(apply_masks(params, masks), plan)
+    new_stats = compact_stats(batch_stats, plan)
     return CompactionResult(
         params=new_params,
         batch_stats=new_stats,
-        width_overrides=width_overrides,
-        report=report,
+        width_overrides=plan.width_overrides,
+        report=plan.report,
     )

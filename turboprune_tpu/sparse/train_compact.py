@@ -1,0 +1,182 @@
+"""Compact-as-you-train: slice the WHOLE train state, not just params.
+
+``compact_params`` (compact.py) serves eval: masks are folded and the
+optimizer is gone. Training a physically smaller model needs more:
+
+  - params stay RAW (they keep evolving) and the sliced mask tree rides
+    along, so ``apply_masks`` inside the jitted step keeps scattered zeros
+    inside kept channels pinned exactly as the dense run would;
+  - optimizer moments (Adam mu/nu, SGD trace, schedule-free z) mirror the
+    params tree inside optax's state tuples and must slice with the SAME
+    keep vectors — JaxPruner's "sparsity threads through the whole train
+    state" design (PAPERS.md);
+  - BN running stats slice along stats_keep;
+  - and the whole thing must round-trip: ``expand_train_state`` scatters a
+    trained small state back into full coordinates so weight rewind, the
+    next level's GLOBAL magnitude threshold, and checkpoints never learn
+    that the level ran small.
+
+Optax states are (named)tuples wrapping params-shaped subtrees next to
+scalar bookkeeping (count, ScaleByScheduleState). The walker below aligns
+leaves by PATH SUFFIX: an opt_state leaf whose trailing dict keys spell a
+params leaf path (…/mu/layer1_0/Conv_0/kernel ↔ layer1_0/Conv_0/kernel)
+and whose sliced axes have the expected sizes gets the params leaf's
+slice; everything else passes through untouched. A suffix match with the
+WRONG axis size raises — that means an optimizer state we don't
+understand, and silently passing it through would corrupt training.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+from .compact import (
+    CompactionPlan,
+    _expand_leaf,
+    _np,
+    _slice_leaf,
+    compact_stats,
+    compact_tree,
+    expand_stats,
+    expand_tree,
+)
+from .graph import PathT
+
+
+def _path_str(entry) -> Optional[str]:
+    """String component of a key-path entry (DictKey/GetAttrKey), else None
+    (SequenceKey/FlattenedIndexKey tuple positions)."""
+    for attr in ("key", "name"):
+        v = getattr(entry, attr, None)
+        if isinstance(v, str):
+            return v
+    return None
+
+
+def _leaf_specs(plan: CompactionPlan) -> dict[PathT, tuple]:
+    """params leaf path -> (in_keep | None, out_keep | None), sliced only."""
+    specs: dict[PathT, tuple] = {}
+    for path in set(plan.in_keep) | set(plan.out_keep):
+        specs[path] = (plan.in_keep.get(path), plan.out_keep.get(path))
+    return specs
+
+
+def _map_opt_state(opt_state: Any, plan: CompactionPlan, expand: bool):
+    """Slice (or expand) every params-aligned leaf of an optax state."""
+    specs = _leaf_specs(plan)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(opt_state)
+    out = []
+    for path, leaf in flat:
+        comps = [c for c in (_path_str(e) for e in path) if c is not None]
+        match = None
+        for n in range(len(comps), 0, -1):
+            cand = tuple(comps[-n:])
+            if cand in specs:
+                match = specs[cand]
+                break
+        if match is None:
+            out.append(leaf)
+            continue
+        ik, ok = match
+        arr = _np(leaf)
+        # Axis-size guard: moments mirror the params leaf exactly; anything
+        # else with the same trailing path is a structure we don't know.
+        want_in = None if ik is None else (int(ik.sum()) if expand else ik.size)
+        want_out = None if ok is None else (int(ok.sum()) if expand else ok.size)
+        if (want_in is not None and (arr.ndim < 2 or arr.shape[-2] != want_in)) or (
+            want_out is not None and (arr.ndim < 1 or arr.shape[-1] != want_out)
+        ):
+            raise ValueError(
+                f"opt_state leaf {'/'.join(comps)} matches a sliced params "
+                f"path but has shape {arr.shape} — unrecognized optimizer "
+                "state layout; refusing to slice it blindly"
+            )
+        out.append(
+            _expand_leaf(arr, ik, ok) if expand else _slice_leaf(arr, ik, ok)
+        )
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def slice_opt_state(opt_state: Any, plan: CompactionPlan) -> Any:
+    """Slice Adam mu/nu, SGD trace, schedule-free z, … with the plan;
+    scalar bookkeeping (count, schedule state) passes through."""
+    return _map_opt_state(opt_state, plan, expand=False)
+
+
+def expand_opt_state(opt_state: Any, plan: CompactionPlan) -> Any:
+    """Inverse: removed coordinates come back as zero moments — exactly the
+    moments a fresh per-level ``tx.init`` would give them, and (with zero
+    data gradient at fully-masked coordinates) what the dense run holds
+    when weight decay is off."""
+    return _map_opt_state(opt_state, plan, expand=True)
+
+
+def compact_train_state(state, plan: CompactionPlan):
+    """Physically shrink a TrainState for one level of compact training.
+
+    params stay raw (NOT mask-folded); the mask tree is sliced alongside so
+    the small train step's ``apply_masks`` semantics match the dense run.
+    step/rng carry over unchanged."""
+    return state.replace(
+        params=compact_tree(state.params, plan),
+        masks=compact_tree(state.masks, plan),
+        batch_stats=compact_stats(state.batch_stats, plan),
+        opt_state=slice_opt_state(state.opt_state, plan),
+    )
+
+
+def expand_train_state(state, plan: CompactionPlan, anchor=None):
+    """Scatter a trained small state back into full coordinates.
+
+    With ``anchor`` (the full-coordinate state captured at compaction
+    time — i.e. the level's post-rewind start state):
+
+      - params: kept coordinates take the trained values; REMOVED
+        coordinates take the anchor's — a removed channel's consumer
+        in-rows hold real magnitudes that the next level's global top-k
+        must still see (zeros would silently re-rank the threshold);
+      - masks: the anchor mask tree verbatim (masks never change during a
+        level; slicing was lossy for consumer in-rows of dead channels);
+      - batch_stats: kept entries trained, removed entries anchored — a
+        removed channel's residue stays exactly the zero it was proven to
+        be at slice time;
+      - opt_state: removed moments are zeros (see expand_opt_state).
+
+    Without an anchor, removed coordinates are zeros everywhere (the pure
+    inverse; property-tested)."""
+    params = expand_tree(
+        state.params, plan, anchor=None if anchor is None else anchor.params
+    )
+    if anchor is not None:
+        masks = anchor.masks
+    else:
+        masks = expand_tree(state.masks, plan)
+    stats = expand_stats(
+        state.batch_stats,
+        plan,
+        anchor=None if anchor is None else anchor.batch_stats,
+    )
+    return state.replace(
+        params=params,
+        masks=masks,
+        batch_stats=stats,
+        opt_state=expand_opt_state(state.opt_state, plan),
+    )
+
+
+def width_signature(plan: CompactionPlan) -> list:
+    """JSON-serializable width signature for multihost agreement."""
+    return sorted(
+        [str(k), int(v)] for k, v in dict(plan.width_overrides).items()
+    )
+
+
+__all__ = [
+    "compact_train_state",
+    "expand_train_state",
+    "expand_opt_state",
+    "slice_opt_state",
+    "width_signature",
+]
