@@ -13,7 +13,13 @@
 #      full coordinates, eval parity holds across the exit expansion,
 #      and the per-width caches evict. Isolated stage so a compaction
 #      regression is named before the full suite runs.
-#   4. tier-1 fast tests        — the same command ROADMAP.md pins,
+#   4. nm smoke                 — the N:M gathered-execution lifecycle on
+#      the same synthetic data: level 0 dense, nm criterion projects at
+#      prune time, the projected level runs gathered and exits back to
+#      the dense step functions with one cached executable, stale plans
+#      evict, and compact_train composes. Isolated so an N:M regression
+#      is named before the full suite runs.
+#   5. tier-1 fast tests        — the same command ROADMAP.md pins,
 #      including its plugin surface (-p no:xdist -p no:randomly), so the
 #      gate and tier-1 agree on what "the suite" is.
 # Exits nonzero if any stage fails. Run from anywhere: paths resolve
@@ -30,6 +36,11 @@ python -m turboprune_tpu.analysis --project turboprune_tpu conf tests
 echo "== compact-train smoke (harness lifecycle on synthetic .tpk) =="
 JAX_PLATFORMS=cpu python -m pytest \
     tests/test_compact_train.py::TestHarnessCompactTrainSmoke -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
+echo "== nm smoke (gathered N:M lifecycle on synthetic .tpk) =="
+JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_nm.py::TestHarnessNMSmoke -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
 echo "== tier-1 tests (fast tier, CPU) =="

@@ -273,3 +273,84 @@ def test_override_key_schema_rejects():
         ConfigError, match="unknown config keys for ExperimentConfig"
     ):
         compose("cifar10_imp", overrides=["experiment_params.bogus=1"])
+
+
+# ---------------------------------------------------- N:M sparsity (PR 6)
+
+
+def test_nm_sparsity_valid_patterns():
+    for pat in ("2:4", "4:8"):
+        cfg = compose(
+            "cifar10_imp",
+            overrides=[f"experiment_params.nm_sparsity='{pat}'"],
+        )
+        assert cfg.experiment_params.nm_sparsity == pat
+        assert cfg.experiment_params.nm_transposable is True
+
+
+def test_nm_sparsity_unquoted_is_yaml_base60_int():
+    """YAML 1.1 parses an unquoted 2:4 as the sexagesimal integer 124;
+    the error must say to quote the value, not report a baffling int."""
+    with pytest.raises(ConfigError, match="base-60"):
+        compose(
+            "cifar10_imp", overrides=["experiment_params.nm_sparsity=2:4"]
+        )
+
+
+@pytest.mark.parametrize(
+    "bad,msg",
+    [
+        ("'0:4'", "0 < N < M"),  # N=0 zeroes every block
+        ("'5:4'", "0 < N < M"),  # N>M impossible
+        ("'4:4'", "0 < N < M"),  # N=M is dense, not a pattern
+        ("'2:1'", "M must be >= 2"),
+        ("'2:4:8'", "not of the form"),
+        ("'a:b'", "must be integers"),
+    ],
+)
+def test_nm_sparsity_malformed_rejected(bad, msg):
+    with pytest.raises(ConfigError, match=msg):
+        compose(
+            "cifar10_imp",
+            overrides=[f"experiment_params.nm_sparsity={bad}"],
+        )
+
+
+def test_nm_sparsity_unsupported_pattern_rejected():
+    # parses fine but is outside NM_SPARSITY_PATTERNS (the literal set
+    # graftlint's conf-bad-choice rule cross-checks)
+    with pytest.raises(ConfigError):
+        compose(
+            "cifar10_imp", overrides=["experiment_params.nm_sparsity='1:4'"]
+        )
+
+
+def test_nm_prune_method_requires_pattern():
+    with pytest.raises(
+        ConfigError, match="requires experiment_params.nm_sparsity"
+    ):
+        compose(
+            "cifar10_imp", overrides=["pruning_params.prune_method=nm"]
+        )
+    cfg = compose(
+        "cifar10_imp",
+        overrides=[
+            "pruning_params.prune_method=nm",
+            "experiment_params.nm_sparsity='2:4'",
+        ],
+    )
+    assert cfg.pruning_params.prune_method == "nm"
+
+
+def test_nm_sparsity_composes_with_compact_train():
+    cfg = compose(
+        "cifar10_imp",
+        overrides=[
+            "experiment_params.nm_sparsity='4:8'",
+            "experiment_params.nm_transposable=false",
+            "experiment_params.compact_train=true",
+        ],
+    )
+    assert cfg.experiment_params.nm_sparsity == "4:8"
+    assert cfg.experiment_params.nm_transposable is False
+    assert cfg.experiment_params.compact_train is True

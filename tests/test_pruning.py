@@ -234,6 +234,45 @@ def test_per_layer_saturated_density_keeps_pruned_weights():
     assert bool(out["layer"]["kernel"].sum() == 3)
 
 
+def test_erk_high_density_redistributes_clamped_excess():
+    """When a layer's ERK score would push its density past 1.0, the layer
+    pins dense and the excess budget must be REDISTRIBUTED (C recomputed
+    over the rest) — not silently dropped, which under-fills the kept
+    budget at high densities (the reference's clamp-only behavior)."""
+    masks = {
+        # tiny layer: huge ERK score sum(shape)/numel -> saturates first
+        "small": {"kernel": jnp.ones((2, 2), jnp.bool_)},
+        "mid": {"kernel": jnp.ones((16, 16), jnp.bool_)},
+        "big": {"kernel": jnp.ones((64, 64), jnp.bool_)},
+    }
+    target = 0.6
+    dens = erk_densities(masks, target)
+    assert dens["small/kernel"] == 1.0
+    assert all(0.0 <= d <= 1.0 for d in dens.values())
+    sizes = {"small/kernel": 4, "mid/kernel": 256, "big/kernel": 4096}
+    kept = sum(dens[n] * sizes[n] for n in sizes)
+    total = sum(sizes.values())
+    # budget met exactly (within float dust), not undershot
+    assert kept / total == pytest.approx(target, abs=1e-6)
+
+
+def test_erk_redistribution_cascade_terminates():
+    """Redistribution can push FURTHER layers over 1.0; the fixed-point
+    iteration must pin them too and still hit the feasible budget."""
+    masks = {
+        "a": {"kernel": jnp.ones((2, 2), jnp.bool_)},
+        "b": {"kernel": jnp.ones((4, 4), jnp.bool_)},
+        "c": {"kernel": jnp.ones((128, 128), jnp.bool_)},
+    }
+    dens = erk_densities(masks, 0.9)
+    assert dens["a/kernel"] == 1.0 and dens["b/kernel"] == 1.0
+    sizes = {"a/kernel": 4, "b/kernel": 16, "c/kernel": 16384}
+    kept = sum(dens[n] * sizes[n] for n in sizes)
+    assert kept / sum(sizes.values()) == pytest.approx(0.9, abs=1e-6)
+    # degenerate: everything pins dense at density 1.0
+    assert all(d == 1.0 for d in erk_densities(masks, 1.0).values())
+
+
 def test_iterative_random_erk_monotone(tiny):
     """random_erk is iterative (ITERATIVE_METHODS); masks must be monotone
     across levels even when small layers saturate at density 1."""

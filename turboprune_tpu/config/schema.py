@@ -25,8 +25,14 @@ PRUNE_METHODS = (
     "synflow",
     "snip",
     "mag",
+    "nm",
     "just dont",
 )
+# N:M structured-sparsity patterns the gathered execution backend supports
+# (sparse/nm.py). The string is parsed by ``parse_nm`` for shape errors
+# (0:4, 5:4, ...) and then checked against this literal set so graftlint's
+# conf-bad-choice rule knows the valid values.
+NM_SPARSITY_PATTERNS = ("2:4", "4:8")
 TRAINING_TYPES = ("imp", "wr", "lrr", "at_init")
 # fp16 included for reference-parity (base_harness.py:92-101); on TPU
 # bfloat16 is the native fast dtype and the recommended default (fp16 has
@@ -61,6 +67,43 @@ class ConfigError(ValueError):
 def _check_choice(name: str, value: Any, choices: tuple) -> None:
     if value not in choices:
         raise ConfigError(f"{name}={value!r} not in {choices}")
+
+
+def parse_nm(spec: str) -> tuple[int, int]:
+    """Parse an ``"N:M"`` sparsity spec into ``(n, m)`` with clear errors.
+
+    Rejects malformed strings and degenerate pairs loudly at compose time —
+    ``0:4`` keeps nothing (every eligible layer would go all-zero), ``4:4``
+    keeps everything (the projection would be an expensive no-op), ``5:4``
+    is impossible. Divisibility against actual layer widths is checked where
+    the widths are known (sparse/nm.py raises NMError there)."""
+    if isinstance(spec, int):
+        # YAML 1.1 parses an unquoted 2:4 as the base-60 integer 124 — by
+        # far the likeliest way an int lands here. Fail with the fix, not
+        # a baffling "124 is not of the form N:M".
+        raise ConfigError(
+            f"nm_sparsity={spec!r}: unquoted N:M is a YAML 1.1 base-60 "
+            f"integer — quote the value, e.g. nm_sparsity='2:4'"
+        )
+    parts = str(spec).split(":")
+    if len(parts) != 2:
+        raise ConfigError(
+            f"nm_sparsity={spec!r} is not of the form 'N:M' (e.g. '2:4')"
+        )
+    try:
+        n, m = int(parts[0]), int(parts[1])
+    except ValueError:
+        raise ConfigError(
+            f"nm_sparsity={spec!r}: N and M must be integers"
+        ) from None
+    if m < 2:
+        raise ConfigError(f"nm_sparsity={spec!r}: M must be >= 2")
+    if not (0 < n < m):
+        raise ConfigError(
+            f"nm_sparsity={spec!r}: need 0 < N < M — N=0 would zero every "
+            f"eligible layer, N>=M keeps everything (no sparsity)"
+        )
+    return n, m
 
 
 @dataclass
@@ -202,7 +245,7 @@ class PruneConfig:
         )
         if not (0.0 <= self.target_sparsity < 1.0):
             raise ConfigError("target_sparsity must be in [0, 1)")
-        if not (0.0 < self.prune_rate < 1.0) and self.prune_method == "mag":
+        if not (0.0 < self.prune_rate < 1.0) and self.prune_method in ("mag", "nm"):
             raise ConfigError("prune_rate must be in (0, 1) for iterative pruning")
         if self.training_type == "wr" and self.rewind_epoch is None:
             raise ConfigError("training_type=wr requires rewind_epoch")
@@ -264,11 +307,28 @@ class ExperimentConfig:
     # level is re-instantiated small (compile + state-slice overhead must
     # be worth it). 0 re-instantiates on any nonzero shrinkage.
     compact_min_savings: float = 0.25
+    # N:M structured sparsity (sparse/nm.py): "" / null = off. When set,
+    # every prune step projects the masks of matmul-heavy layers onto the
+    # highest-magnitude-preserving N:M pattern and the level loop swaps
+    # those layers onto the gathered reduced-width execution path
+    # (sparse/nm_execute.py). Composes with compact_train: channels are
+    # compacted first, the survivors get the N:M treatment.
+    nm_sparsity: Optional[str] = ""
+    # Transposable variant: the pattern satisfies N:M along BOTH matmul
+    # axes so the backward dx contraction also runs reduced (TSENOR-style
+    # alternating solver). False = input-axis-only greedy projection.
+    nm_transposable: bool = True
 
     def validate(self) -> None:
         _check_choice(
             "experiment_params.training_precision", self.training_precision, PRECISIONS
         )
+        if self.nm_sparsity:
+            parse_nm(self.nm_sparsity)
+            _check_choice(
+                "experiment_params.nm_sparsity", self.nm_sparsity,
+                NM_SPARSITY_PATTERNS,
+            )
         if self.epochs_per_level <= 0:
             raise ConfigError("epochs_per_level must be positive")
         if self.model_parallelism < 1:
@@ -403,6 +463,16 @@ class MainConfig:
                 "model_parallelism > 1 requires model_params.attention_impl="
                 "ring (nothing else uses the model axis; dense attention "
                 "would silently duplicate compute across it)"
+            )
+        # Cross-group: prune_method "nm" is magnitude pruning + N:M
+        # projection — without a pattern there is nothing to project onto.
+        if (
+            self.pruning_params.prune_method == "nm"
+            and not self.experiment_params.nm_sparsity
+        ):
+            raise ConfigError(
+                "prune_method='nm' requires experiment_params.nm_sparsity "
+                f"(one of {NM_SPARSITY_PATTERNS})"
             )
         # Cross-group: the rewind snapshot is taken at epoch == rewind_epoch
         # of level 0 (cycle 0 for cyclic) — an out-of-range value would

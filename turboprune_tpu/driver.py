@@ -71,6 +71,13 @@ def prune_level(harness, density: float, level: int) -> None:
     if method in ("snip", "synflow"):
         batch = _first_train_batch(harness)
 
+    nm_spec = None
+    if cfg.experiment_params.nm_sparsity:
+        from .config.schema import parse_nm
+
+        n, m = parse_nm(cfg.experiment_params.nm_sparsity)
+        nm_spec = (n, m, cfg.experiment_params.nm_transposable)
+
     state = harness.state
     before = masking.overall_sparsity(state.masks)
     masks = prune_the_model(
@@ -83,14 +90,29 @@ def prune_level(harness, density: float, level: int) -> None:
         density,
         rng,
         batch=batch,
+        nm=nm_spec if method == "nm" else None,
     )
+    nm_note = ""
+    if nm_spec is not None and method not in ("nm", "just dont"):
+        # Projection post-pass on any other criterion: snap its mask to the
+        # N:M pattern (monotone — the ladder's no-resurrection invariant
+        # holds; the "nm" criterion projects inside prune_the_model).
+        from .sparse.nm import project_masks
+
+        masks, nm_report = project_masks(
+            state.params, masks, nm_spec[0], nm_spec[1], nm_spec[2]
+        )
+        nm_note = (
+            f", {cfg.experiment_params.nm_sparsity} projection kept "
+            f"{nm_report['preserved_magnitude_frac']:.3f} of magnitude"
+        )
     state = state.replace(masks=masks)
     harness.state = state
     after = masking.overall_sparsity(state.masks)
     if is_primary():
         print(
             f"[prune] level {level}: {method} to density {density:.4f} "
-            f"(sparsity {before:.2f}% -> {after:.2f}%)",
+            f"(sparsity {before:.2f}% -> {after:.2f}%){nm_note}",
             flush=True,
         )
     # Rewind AFTER pruning: masks survive, weights roll back per

@@ -67,6 +67,7 @@ class CyclicPruningHarness(PruningHarness):
             if cycle == 0:
                 self.maybe_rewind_optimizer(level)
             self._maybe_enter_compact_train()
+            self._maybe_enter_nm_exec()
             try:
                 for epoch in range(epochs):
                     row = {"level": level, "cycle": cycle, "epoch": epoch}
@@ -93,6 +94,7 @@ class CyclicPruningHarness(PruningHarness):
                             OPTIMIZER_REWIND, full.opt_state
                         )
             finally:
+                self._exit_nm_exec()
                 self._exit_compact_train()
 
         return self.metrics.finish_level(

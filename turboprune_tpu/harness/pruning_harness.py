@@ -190,6 +190,24 @@ class PruningHarness:
         # bench/tests can read the size the level ACTUALLY compiled.
         self._compact_step_cache: dict[tuple, tuple] = {}
         self._compact_ctx: Optional[dict] = None
+        # Opt-in gathered N:M execution (experiment_params.nm_sparsity): once
+        # a level's masks carry a separable N:M pattern (sparse/nm.py), the
+        # level trains/evals through the reduced-width gathered path
+        # (sparse/nm_execute.py) at FULL param shapes — a function swap only,
+        # no state transformation and no anchor. Step bundles are cached by
+        # (total_steps, compact width signature, nm index signature).
+        self._nm_step_cache: dict[tuple, tuple] = {}
+        self._nm_ctx: Optional[dict] = None
+        self.last_nm_report: Optional[dict] = None
+        if ep.nm_sparsity:
+            # Fail fast at harness construction: a contraction width that
+            # does not divide into M-blocks would otherwise only surface at
+            # the first prune step, a full level of training later.
+            from ..config.schema import parse_nm
+            from ..sparse.nm import check_divisibility
+
+            _, m_block = parse_nm(ep.nm_sparsity)
+            check_divisibility(self.state.masks, m_block)
         from ..serve.metrics import ServeMetrics
 
         self.compact_metrics = ServeMetrics()
@@ -347,10 +365,16 @@ class PruningHarness:
             ev_state = ev_state.replace(
                 params=eval_params(ev_state.opt_state, ev_state.params)
             )
-        if self.cfg.experiment_params.compact_eval and self._compact_ctx is None:
+        if (
+            self.cfg.experiment_params.compact_eval
+            and self._compact_ctx is None
+            and self._nm_ctx is None
+        ):
             # With compact TRAINING live the state is already small and
             # _eval_step/_scan_eval are the small model's — re-compacting
             # sliced params against the full model's graph would be wrong.
+            # With N:M execution live, _eval_step already runs the gathered
+            # reduced-width path — that IS the level's compact eval.
             return self._evaluate_compacted(ev_state)
         test_loader = self.loaders.test_loader
         if hasattr(test_loader, "eval_epoch_arrays"):
@@ -428,10 +452,11 @@ class PruningHarness:
         }
 
     # ------------------------------------------------------- compact train
-    def _small_model(self, width_overrides):
-        """Re-instantiate the architecture at compacted widths. Ring
-        attention falls back to its param-identical dense equivalent (as in
-        serving): the small model is replicated, not sequence-sharded."""
+    def _small_model(self, width_overrides, nm_overrides=None):
+        """Re-instantiate the architecture at compacted widths and/or with
+        gathered N:M hooks. Ring attention falls back to its param-identical
+        dense equivalent (as in serving): the small model is replicated, not
+        sequence-sharded."""
         attention_impl = self.cfg.model_params.attention_impl
         if attention_impl == "ring":
             attention_impl = "dense"
@@ -443,6 +468,7 @@ class PruningHarness:
             attention_impl=attention_impl,
             mesh=self.mesh,
             width_overrides=width_overrides,
+            nm_overrides=nm_overrides,
         )
 
     def _maybe_enter_compact_train(self) -> None:
@@ -560,6 +586,105 @@ class PruningHarness:
             self.mesh,
         )
 
+    # ---------------------------------------------------------- nm execute
+    def _maybe_enter_nm_exec(self) -> None:
+        """Swap the level's step functions onto the gathered N:M execution
+        path (sparse/nm_execute.py) when the live masks have reducible
+        contraction axes.
+
+        Called AFTER _maybe_enter_compact_train: the plan is built from the
+        LIVE masks (full-coordinate or compact-sliced — live-row detection
+        is exact either way, which is what makes the two backends compose:
+        channel-compact first, N:M the survivors). Params keep their current
+        shapes — this is a function swap only, no state transformation and
+        no anchor. No collective is needed: the plan is a pure function of
+        the masks + model family, and mask agreement across hosts is already
+        asserted once per level (driver.prune_level's exact
+        check_state_equality), so every process derives the identical plan.
+        """
+        ep = self.cfg.experiment_params
+        if not ep.nm_sparsity or self._nm_ctx is not None:
+            return
+        from ..sparse import build_nm_plan
+
+        in_compact = self._compact_ctx is not None
+        wov = (
+            self._compact_ctx["plan"].width_overrides if in_compact else None
+        )
+        exec_model = self._small_model(wov) if in_compact else self.model
+        plan = build_nm_plan(exec_model, self.state.masks)
+        self.last_nm_report = plan.report
+        self.compact_metrics.set_gauge(
+            "nm_coverage_frac", plan.report["coverage_frac"]
+        )
+        if not plan.overrides:
+            # Dense or unprojected masks (e.g. level 0): nothing to gather.
+            return
+
+        total_steps = self._current_epochs * self.steps_per_epoch
+        width_key = (
+            self._compact_ctx["plan"].as_override_tuple() if in_compact else ()
+        )
+        nm_key = plan.as_override_tuple()
+        key = (total_steps, width_key, nm_key)
+        # The ladder only descends — step bundles for older (level, mask)
+        # signatures can never be hit again.
+        for k in [k for k in self._nm_step_cache if k[1:] != (width_key, nm_key)]:
+            del self._nm_step_cache[k]
+        if key not in self._nm_step_cache:
+            nm_model = self._small_model(wov, nm_overrides=plan.overrides)
+            tx, schedule = self._build_tx(self._current_epochs)
+            raw_step = make_train_step(nm_model, tx, schedule)
+            raw_eval = make_eval_step(nm_model)
+            self._nm_step_cache[key] = (
+                make_sharded_train_step(raw_step, self.mesh),
+                make_sharded_scan_epoch(make_scan_epoch(raw_step), self.mesh),
+                make_sharded_scan_chunk(make_scan_chunk(raw_step), self.mesh),
+                make_sharded_eval_step(raw_eval, self.mesh),
+                make_sharded_scan_eval(make_scan_eval(raw_eval), self.mesh),
+            )
+        self._export_cache_gauges()
+        self._nm_ctx = {
+            "dense_fns": (
+                self._train_step,
+                self._scan_epoch,
+                self._scan_chunk,
+                self._eval_step,
+                self._scan_eval,
+            ),
+        }
+        (
+            self._train_step,
+            self._scan_epoch,
+            self._scan_chunk,
+            self._eval_step,
+            self._scan_eval,
+        ) = self._nm_step_cache[key]
+        if is_primary():
+            r = plan.report
+            print(
+                f"[nm-exec] level runs gathered {ep.nm_sparsity}: "
+                f"{len(plan.overrides)} layers routed, coverage "
+                f"{r['coverage_frac']:.2f} of eligible params",
+                flush=True,
+            )
+
+    def _exit_nm_exec(self) -> None:
+        """Restore the masked-dense step functions. Idempotent; must run
+        BEFORE _exit_compact_train in the level's finally — its stashed fns
+        are the compact model's while compaction is live."""
+        if self._nm_ctx is None:
+            return
+        ctx = self._nm_ctx
+        self._nm_ctx = None
+        (
+            self._train_step,
+            self._scan_epoch,
+            self._scan_chunk,
+            self._eval_step,
+            self._scan_eval,
+        ) = ctx["dense_fns"]
+
     def _full_state(self) -> TrainState:
         """The live state in FULL coordinates — what every checkpoint
         (rewind artifacts, mid-level slots) must hold so restores never
@@ -594,6 +719,9 @@ class PruningHarness:
         )
         self.compact_metrics.set_gauge(
             "compact_eval_cache_size", len(self._compact_eval_cache)
+        )
+        self.compact_metrics.set_gauge(
+            "nm_exec_cache_size", len(self._nm_step_cache)
         )
 
     # --------------------------------------------------------------- level
@@ -680,8 +808,10 @@ class PruningHarness:
                     )
         # After any mid-level restore, so the anchor is the true level-start
         # full state (post-rewind, post-resume) and a resumed level re-enters
-        # compaction from the restored full coordinates.
+        # compaction from the restored full coordinates. N:M enters second
+        # so its plan sees the compact-sliced masks when compaction commits.
         self._maybe_enter_compact_train()
+        self._maybe_enter_nm_exec()
         try:
             for epoch in range(start_epoch, epochs_per_level):
                 # Trace the second epoch of level 0 (first is
@@ -747,6 +877,7 @@ class PruningHarness:
                         level, epoch, self._full_state(), meta=meta
                     )
         finally:
+            self._exit_nm_exec()
             self._exit_compact_train()
 
         return self.metrics.finish_level(

@@ -56,6 +56,7 @@ def create_model(
     attention_impl: str = "dense",
     mesh: Any = None,
     width_overrides: Any = None,
+    nm_overrides: Any = None,
 ):
     """Build a model module with dataset-appropriate stem.
 
@@ -66,7 +67,11 @@ def create_model(
 
     ``width_overrides`` (mapping of space name -> kept channels, from
     ``sparse.compact_params``) re-instantiates a dead-channel-compacted
-    model; normalized to a sorted tuple so the module stays hashable."""
+    model; normalized to a sorted tuple so the module stays hashable.
+    ``nm_overrides`` (hook key -> (kept_in, kept_out) index tuples, from
+    ``sparse.nm_execute.build_nm_plan``) routes matmul-heavy layers through
+    the gathered N:M path; same normalization, composes with
+    ``width_overrides``."""
     if model_name not in MODEL_REGISTRY:
         raise ValueError(
             f"Model {model_name!r} not in registry: {sorted(MODEL_REGISTRY)}"
@@ -82,6 +87,8 @@ def create_model(
         )
     if width_overrides:
         kwargs["width_overrides"] = tuple(sorted(dict(width_overrides).items()))
+    if nm_overrides:
+        kwargs["nm_overrides"] = tuple(sorted(dict(nm_overrides).items()))
     return MODEL_REGISTRY[model_name](
         num_classes, cifar_stem=cifar_stem, dtype=compute_dtype, **kwargs
     )

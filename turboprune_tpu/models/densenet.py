@@ -81,6 +81,10 @@ class DenseNet(nn.Module):
     # "conv0" / "denseblock{i}_layer{j}/conv{1,2}" / "transition{i}/conv"
     # -> kept channels. Mapping or tuple of pairs; absent keys stay dense.
     width_overrides: Any = None
+    # Gathered N:M execution hook (sparse/nm_execute.py): "classifier" ->
+    # (kept_in, kept_out) static index tuples. The bottleneck/transition
+    # 1x1 convs feed concat-shared channel spaces and stay dense.
+    nm_overrides: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -136,6 +140,17 @@ class DenseNet(nn.Module):
         x = nn.relu(x)
         x = jnp.mean(x, axis=(1, 2))
         x = x.astype(jnp.float32)
+        nm_cls = dict(self.nm_overrides or {}).get("classifier")
+        if nm_cls is not None:
+            from ..sparse.nm_execute import NMDense
+
+            return NMDense(
+                self.num_classes,
+                kept_in=nm_cls[0],
+                kept_out=nm_cls[1],
+                dtype=jnp.float32,
+                name="classifier",
+            )(x)
         return nn.Dense(self.num_classes, dtype=jnp.float32, name="classifier")(x)
 
 

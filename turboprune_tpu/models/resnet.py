@@ -75,20 +75,46 @@ class Bottleneck(nn.Module):
     # Compacted inner widths for (Conv_0, Conv_1); the 1x1 expansion conv
     # produces the residual-shared block output and is never compacted.
     inner_widths: Any = None
+    # Gathered N:M hook for the leading 1x1 conv (sparse/nm_execute.py):
+    # (kept_in, kept_out) index tuples or None. Only Conv_0 takes the hook —
+    # the expansion 1x1 feeds the residual add and stays dense.
+    nm_conv0: Any = None
 
     @nn.compact
     def __call__(self, x):
         residual = x
         inner = int(self.filters * self.inner_multiplier)
         iw = self.inner_widths or (None, None)
-        y = self.conv(iw[0] or inner, (1, 1))(x)
+        # Convs are named explicitly (matching flax's would-be auto names)
+        # so swapping Conv_0 for NMConv1x1 can't shift the nn.Conv
+        # auto-name counter and silently rename the rest of the block.
+        if self.nm_conv0 is not None:
+            from ..sparse.nm_execute import NMConv1x1
+
+            ckw = self.conv.keywords
+            y = NMConv1x1(
+                features=iw[0] or inner,
+                kept_in=self.nm_conv0[0],
+                kept_out=self.nm_conv0[1],
+                use_bias=ckw.get("use_bias", True),
+                dtype=ckw.get("dtype", jnp.float32),
+                kernel_init=ckw.get(
+                    "kernel_init", nn.initializers.lecun_normal()
+                ),
+                name="Conv_0",
+            )(x)
+        else:
+            y = self.conv(iw[0] or inner, (1, 1), name="Conv_0")(x)
         y = self.norm()(y)
         y = nn.relu(y)
         # torchvision puts the stride on the 3x3 conv (ResNet v1.5)
-        y = self.conv(iw[1] or inner, (3, 3), strides=(self.strides, self.strides))(y)
+        y = self.conv(
+            iw[1] or inner, (3, 3), strides=(self.strides, self.strides),
+            name="Conv_1",
+        )(y)
         y = self.norm()(y)
         y = nn.relu(y)
-        y = self.conv(self.filters * self.expansion, (1, 1))(y)
+        y = self.conv(self.filters * self.expansion, (1, 1), name="Conv_2")(y)
         y = self.norm(scale_init=nn.initializers.ones)(y)
         if residual.shape != y.shape:
             residual = self.conv(
@@ -119,6 +145,10 @@ class ResNet(nn.Module):
     # "layer{i}_{j}/Conv_{k}" to the kept channel count of that
     # block-internal axis. None/absent keys keep the dense width.
     width_overrides: Any = None
+    # Gathered N:M execution hooks (sparse/nm_execute.py, built by
+    # build_nm_plan): "fc" and (Bottleneck only) "layer{i}_{j}/Conv_0" ->
+    # (kept_in, kept_out) static index tuples; absent keys run dense.
+    nm_overrides: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -154,6 +184,7 @@ class ResNet(nn.Module):
             else {}
         )
         ov = dict(self.width_overrides or {})
+        nv = dict(self.nm_overrides or {})
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = 2 if i > 0 and j == 0 else 1
@@ -162,6 +193,7 @@ class ResNet(nn.Module):
                     ov.get(f"{name}/Conv_0"),
                     ov.get(f"{name}/Conv_1"),
                 )
+                nm_conv0 = nv.get(f"{name}/Conv_0")
                 x = self.block_cls(
                     filters=self.width * 2**i,
                     strides=strides,
@@ -171,11 +203,26 @@ class ResNet(nn.Module):
                     inner_widths=(
                         inner_widths if any(inner_widths) else None
                     ),
+                    # BasicBlock has no hookable 1x1; the plan builder only
+                    # emits Conv_0 keys for Bottleneck models.
+                    **({"nm_conv0": nm_conv0} if nm_conv0 is not None else {}),
                     **block_kw,
                 )(x)
         x = jnp.mean(x, axis=(1, 2))
         x = x.astype(jnp.float32)
-        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(x)
+        nm_fc = nv.get("fc")
+        if nm_fc is not None:
+            from ..sparse.nm_execute import NMDense
+
+            x = NMDense(
+                self.num_classes,
+                kept_in=nm_fc[0],
+                kept_out=nm_fc[1],
+                dtype=jnp.float32,
+                name="fc",
+            )(x)
+        else:
+            x = nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(x)
         return x
 
 

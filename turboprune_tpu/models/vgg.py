@@ -62,6 +62,10 @@ class VGG(nn.Module):
     # "conv{k}" / "fc0" / "fc1" -> kept channel count. Mapping or tuple of
     # pairs (hashable for Module cloning); absent keys keep dense widths.
     width_overrides: Any = None
+    # Gathered N:M execution hooks (sparse/nm_execute.py, built by
+    # build_nm_plan): "fc0" | "fc1" | "fc2" -> (kept_in, kept_out) static
+    # index tuples; absent keys run dense.
+    nm_overrides: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -96,17 +100,29 @@ class VGG(nn.Module):
                 conv_idx += 1
         x = adaptive_avg_pool(x, 7)
         x = x.reshape((x.shape[0], -1)).astype(jnp.float32)
-        x = nn.Dense(
-            ov.get("fc0", self.fc_features[0]), dtype=jnp.float32, name="fc0"
-        )(x)
+        nv = dict(self.nm_overrides or {})
+
+        def fc(name, features):
+            nm = nv.get(name)
+            if nm is not None:
+                from ..sparse.nm_execute import NMDense
+
+                return NMDense(
+                    features,
+                    kept_in=nm[0],
+                    kept_out=nm[1],
+                    dtype=jnp.float32,
+                    name=name,
+                )
+            return nn.Dense(features, dtype=jnp.float32, name=name)
+
+        x = fc("fc0", ov.get("fc0", self.fc_features[0]))(x)
         x = nn.relu(x)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
-        x = nn.Dense(
-            ov.get("fc1", self.fc_features[1]), dtype=jnp.float32, name="fc1"
-        )(x)
+        x = fc("fc1", ov.get("fc1", self.fc_features[1]))(x)
         x = nn.relu(x)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
-        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="fc2")(x)
+        x = fc("fc2", self.num_classes)(x)
         return x
 
 

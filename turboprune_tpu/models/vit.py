@@ -126,13 +126,30 @@ class MlpBlock(nn.Module):
     out_dim: int
     dropout_rate: float = 0.0
     dtype: Any = jnp.float32
+    # Gathered N:M execution hooks (sparse/nm_execute.py): (kept_in,
+    # kept_out) index tuples or None. Param trees are identical either way.
+    nm_fc1: Any = None
+    nm_fc2: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = nn.Dense(self.hidden_dim, dtype=self.dtype, name="fc1")(x)
+        def dense(features, nm, name):
+            if nm is not None:
+                from ..sparse.nm_execute import NMDense
+
+                return NMDense(
+                    features,
+                    kept_in=nm[0],
+                    kept_out=nm[1],
+                    dtype=self.dtype,
+                    name=name,
+                )
+            return nn.Dense(features, dtype=self.dtype, name=name)
+
+        x = dense(self.hidden_dim, self.nm_fc1, "fc1")(x)
         x = nn.gelu(x, approximate=False)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
-        x = nn.Dense(self.out_dim, dtype=self.dtype, name="fc2")(x)
+        x = dense(self.out_dim, self.nm_fc2, "fc2")(x)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         return x
 
@@ -148,6 +165,12 @@ class EncoderBlock(nn.Module):
     mesh: Any = None  # required for attention_impl="ring"
     # Compacted MLP hidden width (sparse/compact.py); None = dim*mlp_ratio.
     mlp_hidden: Any = None
+    # Gathered N:M hooks (sparse/nm_execute.py): nm_attn is a tuple of
+    # ("query"|"key"|"value"|"out", (kept_in, kept_out)) pairs (dense
+    # attention only); nm_fc1/nm_fc2 are per-projection hooks.
+    nm_attn: Any = None
+    nm_fc1: Any = None
+    nm_fc2: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -171,6 +194,20 @@ class EncoderBlock(nn.Module):
             y = FlashSelfAttention(
                 num_heads=self.num_heads, dtype=self.dtype, name="attn"
             )(y)
+        elif self.nm_attn:
+            if self.attn_dropout_rate > 0:
+                raise ValueError(
+                    "gathered N:M attention has no dropout path — use "
+                    "attn_dropout_rate=0 or disable nm_sparsity"
+                )
+            from ..sparse.nm_execute import NMSelfAttention
+
+            y = NMSelfAttention(
+                num_heads=self.num_heads,
+                nm=tuple(self.nm_attn),
+                dtype=self.dtype,
+                name="attn",
+            )(y)
         else:
             y = nn.MultiHeadDotProductAttention(
                 num_heads=self.num_heads,
@@ -186,6 +223,8 @@ class EncoderBlock(nn.Module):
             out_dim=dim,
             dropout_rate=self.dropout_rate,
             dtype=self.dtype,
+            nm_fc1=self.nm_fc1,
+            nm_fc2=self.nm_fc2,
             name="mlp",
         )(y, train=train)
         return x + y
@@ -210,6 +249,12 @@ class VisionTransformer(nn.Module):
     # "block{i}/mlp/fc1" -> kept hidden width. Mapping or tuple of pairs;
     # absent keys keep dim * mlp_ratio.
     width_overrides: Any = None
+    # Gathered N:M execution hooks (sparse/nm_execute.py, built by
+    # build_nm_plan): "block{i}/attn/query" | "block{i}/mlp/fc1" | "head" |
+    # "head_dist" -> (kept_in, kept_out) static index tuples. Absent keys
+    # run dense (masked outside the model). Composes with width_overrides:
+    # compaction shrinks the physical width first, N:M gathers survivors.
+    nm_overrides: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -250,7 +295,13 @@ class VisionTransformer(nn.Module):
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
 
         ov = dict(self.width_overrides or {})
+        nv = dict(self.nm_overrides or {})
         for i in range(self.depth):
+            nm_attn = tuple(
+                (p, nv[f"block{i}/attn/{p}"])
+                for p in ("query", "key", "value", "out")
+                if f"block{i}/attn/{p}" in nv
+            )
             x = EncoderBlock(
                 num_heads=self.num_heads,
                 mlp_ratio=self.mlp_ratio,
@@ -259,15 +310,32 @@ class VisionTransformer(nn.Module):
                 attention_impl=self.attention_impl,
                 mesh=self.mesh,
                 mlp_hidden=ov.get(f"block{i}/mlp/fc1"),
+                nm_attn=nm_attn or None,
+                nm_fc1=nv.get(f"block{i}/mlp/fc1"),
+                nm_fc2=nv.get(f"block{i}/mlp/fc2"),
                 name=f"block{i}",
             )(x, train=train)
         x = nn.LayerNorm(epsilon=1e-6, name="norm")(x)
         x = x.astype(jnp.float32)
 
-        head = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")
+        def head_module(name):
+            nm = nv.get(name)
+            if nm is not None:
+                from ..sparse.nm_execute import NMDense
+
+                return NMDense(
+                    self.num_classes,
+                    kept_in=nm[0],
+                    kept_out=nm[1],
+                    dtype=jnp.float32,
+                    name=name,
+                )
+            return nn.Dense(self.num_classes, dtype=jnp.float32, name=name)
+
+        head = head_module("head")
         if not self.distilled:
             return head(x[:, 0])
-        head_dist = nn.Dense(self.num_classes, dtype=jnp.float32, name="head_dist")
+        head_dist = head_module("head_dist")
         # Mean of both heads, train and eval alike: without a teacher there
         # is no distillation loss, so the dist token is just a second head
         # (the reference's DeiT path was unreachable anyway, SURVEY.md §2.1).

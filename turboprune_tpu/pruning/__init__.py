@@ -23,6 +23,7 @@ from .criteria import (
     prune_er_balanced,
     prune_er_erk,
     prune_mag,
+    prune_nm,
     prune_random_balanced,
     prune_random_erk,
     prune_snip,
@@ -30,7 +31,9 @@ from .criteria import (
 )
 from .densities import generate_cyclical_schedule, generate_densities
 
-DATA_FREE_METHODS = ("mag", "random_erk", "random_balanced", "er_erk", "er_balanced")
+DATA_FREE_METHODS = (
+    "mag", "nm", "random_erk", "random_balanced", "er_erk", "er_balanced"
+)
 DATA_DRIVEN_METHODS = ("snip", "synflow")
 
 
@@ -47,18 +50,28 @@ def prune_the_model(
     density: float,
     rng: jax.Array,
     batch: Optional[tuple] = None,
+    nm: Optional[tuple] = None,
 ) -> PyTree:
     """Dispatch a pruning criterion; returns the new mask pytree.
 
     ``batch`` (images, labels) is required for snip (real data) and synflow
     (shape/dtype only — it forwards an all-ones input, reference
-    pruning_utils.py:256-257)."""
+    pruning_utils.py:256-257). ``nm`` = (n, m, transposable) is required
+    for the "nm" criterion (the harness derives it from
+    ``experiment_params.nm_sparsity``)."""
     params = variables["params"]
 
     if method == "just dont":
         return masks
     if method == "mag":
         return prune_mag(params, masks, density)
+    if method == "nm":
+        if nm is None:
+            raise ValueError(
+                "prune_method 'nm' needs nm=(n, m, transposable) — set "
+                "experiment_params.nm_sparsity"
+            )
+        return prune_nm(params, masks, density, nm[0], nm[1], nm[2])
     if method == "random_erk":
         return prune_random_erk(params, masks, density, rng)
     if method == "random_balanced":
@@ -119,6 +132,7 @@ def prune_the_model(
 __all__ = [
     "prune_the_model",
     "prune_mag",
+    "prune_nm",
     "prune_snip",
     "prune_synflow",
     "prune_random_erk",

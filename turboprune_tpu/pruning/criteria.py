@@ -10,6 +10,7 @@ Rebuilds every criterion of the reference's pruning engine
   random_balanced  equal per-layer budget + random      (:288-347)
   er_erk           ERK densities, Bernoulli masks (PaI) (:350-378)
   er_balanced      balanced densities, Bernoulli (PaI)  (:381-415)
+  nm               mag + N:M projection (sparse/nm.py)  (this repo only)
 
 All run replicated on every host from replicated state — determinism by
 construction replaces the reference's rank-0-prune + DDP-broadcast dance
@@ -38,6 +39,10 @@ from ..ops.masking import (
     per_layer_threshold_mask,
 )
 
+# Budget allocators live in densities.py (pure shape math); re-exported
+# here because criteria was their historical home.
+from .densities import _layer_sizes, balanced_densities, erk_densities
+
 # ---------------------------------------------------------------------------
 # helpers
 
@@ -56,53 +61,6 @@ def _random_normal_scores(masks: PyTree, rng: jax.Array) -> PyTree:
         )
 
     return mask_where(masks, score)
-
-
-def _layer_sizes(masks: PyTree) -> list[tuple[str, tuple, int]]:
-    """[(path_name, shape, numel)] per prunable layer, in traversal order."""
-    out = []
-    for path, m in mask_leaves_with_path(masks):
-        out.append((path_name(path), tuple(m.shape), int(m.size)))
-    return out
-
-
-def erk_densities(masks: PyTree, density: float) -> dict[str, float]:
-    """ERK allocation: layer density ∝ sum(kernel shape)/numel, scaled by a
-    global factor C so the total kept-parameter budget hits ``density``, each
-    clamped to [0, 1] (reference pruning_utils.py:102-127, 357-371).
-
-    Note: the reference computes the fc layer's shape sum through its
-    Conv1dMask (out, in, 1) representation, adding a stray +1; we use the
-    true (in, out) Dense shape."""
-    layers = _layer_sizes(masks)
-    raw = [sum(shape) / numel for _, shape, numel in layers]
-    total = sum(numel for _, _, numel in layers)
-    kept = sum(r * numel for r, (_, _, numel) in zip(raw, layers))
-    c = density * total / kept
-    return {
-        name: float(min(max(c * r, 0.0), 1.0))
-        for r, (name, _, _) in zip(raw, layers)
-    }
-
-
-def balanced_densities(masks: PyTree, density: float) -> dict[str, float]:
-    """Balanced allocation: equal kept-parameter count X = density*total/L per
-    layer; layers smaller than X saturate at density 1 and their surplus is
-    redistributed (reference pruning_utils.py:298-327, 388-407, including its
-    L - i divisor)."""
-    layers = _layer_sizes(masks)
-    total = sum(numel for _, _, numel in layers)
-    L = len(layers)
-    X = density * total / L
-    out = {}
-    for i, (name, _, numel) in enumerate(layers):
-        if X / numel < 1.0:
-            out[name] = X / numel
-        else:
-            out[name] = 1.0
-            diff = X - numel
-            X = X + diff / (L - i)
-    return out
 
 
 def _bernoulli_masks(
@@ -133,6 +91,27 @@ def prune_mag(params: PyTree, masks: PyTree, density: float) -> PyTree:
         masks, lambda m, p: jnp.abs(p * m.astype(p.dtype)), params
     )
     return global_threshold_mask(scores, masks, density)
+
+
+def prune_nm(
+    params: PyTree,
+    masks: PyTree,
+    density: float,
+    n: int,
+    m: int,
+    transposable: bool = True,
+) -> PyTree:
+    """Magnitude IMP step + N:M projection: the global-threshold mask is
+    snapped to the highest-magnitude-preserving separable N:M pattern per
+    layer (sparse/nm.py). Projection is monotone (mask & pattern), so the
+    no-resurrection invariant the ladder depends on survives; achieved
+    density lands below the ladder target by the projection's cut, which is
+    the structured-sparsity price the pattern pays for real speedup."""
+    from ..sparse.nm import project_masks
+
+    new_masks = prune_mag(params, masks, density)
+    projected, _ = project_masks(params, new_masks, n, m, transposable)
+    return projected
 
 
 def prune_random_erk(

@@ -1,4 +1,5 @@
-"""Density ladders and cyclic epoch schedules (host-side math).
+"""Density ladders, per-layer allocations and cyclic epoch schedules
+(host-side math).
 
 Parity targets: ``generate_densities`` (/root/reference/utils/
 harness_utils.py:117-145) and ``generate_cyclical_schedule``
@@ -6,12 +7,83 @@ harness_utils.py:117-145) and ``generate_cyclical_schedule``
 called — `cyclic_harness.py:175` passes `epochs_per_level=` to a `(cfg)`
 signature and TypeErrors whenever num_cycles > 1 (SURVEY.md §2.1) — so here
 the function takes explicit arguments and works.
+
+The per-layer allocators (``erk_densities``/``balanced_densities``) live
+here too — they are pure budget math over layer shapes, not criteria.
 """
 
 from __future__ import annotations
 
-ITERATIVE_METHODS = ("mag", "random_erk", "random_balanced")
+from ..ops.masking import PyTree, mask_leaves_with_path, path_name
+
+# "nm" is magnitude IMP + N:M projection (criteria.prune_nm): same
+# geometric ladder as "mag".
+ITERATIVE_METHODS = ("mag", "random_erk", "random_balanced", "nm")
 PAI_METHODS = ("er_erk", "er_balanced", "synflow", "snip")
+
+
+def _layer_sizes(masks: PyTree) -> list[tuple[str, tuple, int]]:
+    """[(path_name, shape, numel)] per prunable layer, in traversal order."""
+    out = []
+    for path, m in mask_leaves_with_path(masks):
+        out.append((path_name(path), tuple(m.shape), int(m.size)))
+    return out
+
+
+def erk_densities(masks: PyTree, density: float) -> dict[str, float]:
+    """ERK allocation: layer density ∝ sum(kernel shape)/numel, scaled by a
+    global factor C so the total kept-parameter budget hits ``density``
+    (reference pruning_utils.py:102-127, 357-371).
+
+    Layers whose scaled density exceeds 1.0 are pinned dense and the excess
+    budget is REDISTRIBUTED over the remaining layers by recomputing C
+    (iterated to a fixed point — a redistribution can push further layers
+    over 1.0). The reference clamps without redistributing, silently keeping
+    fewer parameters than the requested budget at high densities; at
+    moderate densities (nothing clamps) the two are identical.
+
+    Note: the reference computes the fc layer's shape sum through its
+    Conv1dMask (out, in, 1) representation, adding a stray +1; we use the
+    true (in, out) Dense shape."""
+    layers = _layer_sizes(masks)
+    raw = {name: sum(shape) / numel for name, shape, numel in layers}
+    sizes = {name: numel for name, _, numel in layers}
+    budget = density * sum(sizes.values())
+    pinned: set[str] = set()
+    c = 0.0
+    while True:
+        rest = [name for name, _, _ in layers if name not in pinned]
+        remaining = budget - sum(sizes[name] for name in pinned)
+        denom = sum(raw[name] * sizes[name] for name in rest)
+        c = remaining / denom if denom > 0 else 0.0
+        overflow = [name for name in rest if c * raw[name] > 1.0]
+        if not overflow or not rest:
+            break
+        pinned.update(overflow)
+    return {
+        name: 1.0 if name in pinned else float(min(max(c * raw[name], 0.0), 1.0))
+        for name, _, _ in layers
+    }
+
+
+def balanced_densities(masks: PyTree, density: float) -> dict[str, float]:
+    """Balanced allocation: equal kept-parameter count X = density*total/L per
+    layer; layers smaller than X saturate at density 1 and their surplus is
+    redistributed (reference pruning_utils.py:298-327, 388-407, including its
+    L - i divisor)."""
+    layers = _layer_sizes(masks)
+    total = sum(numel for _, _, numel in layers)
+    L = len(layers)
+    X = density * total / L
+    out = {}
+    for i, (name, _, numel) in enumerate(layers):
+        if X / numel < 1.0:
+            out[name] = X / numel
+        else:
+            out[name] = 1.0
+            diff = X - numel
+            X = X + diff / (L - i)
+    return out
 
 
 def generate_densities(

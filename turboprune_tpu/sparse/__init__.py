@@ -15,9 +15,17 @@ train_compact.py  ``compact_train_state``/``expand_train_state`` — the
                   moments) sliced for compact-as-you-train and scattered
                   back to full coordinates for pruning/rewind/checkpoints
 
+nm.py             N:M projection — snap unstructured masks to separable
+                  (transposable) N:M block patterns, highest preserved
+                  magnitude per M-block, vmap-batched solvers
+nm_execute.py     gathered N:M execution — static int32 index maps +
+                  custom-VJP reduced-width matmul, NM* drop-in modules and
+                  ``build_nm_plan``; the second execution backend next to
+                  compaction (composable: compact first, N:M the survivors)
+
 Consumed by serve/engine.py (``compact: true`` load path), the harness's
 compact eval AND compact train paths, and bench.py's ``compaction`` /
-``compact_train`` stages.
+``compact_train`` / ``nm_frontier`` stages.
 """
 
 from .compact import (
@@ -32,6 +40,14 @@ from .compact import (
     expand_tree,
 )
 from .graph import CompactionError, PropagationGraph, build_graph
+from .nm import (
+    NMError,
+    check_divisibility,
+    nm_pattern_inaxis,
+    nm_pattern_transposable,
+    project_masks,
+)
+from .nm_execute import NMExecPlan, build_nm_plan
 from .train_compact import (
     compact_train_state,
     expand_opt_state,
@@ -44,10 +60,14 @@ __all__ = [
     "CompactionError",
     "CompactionPlan",
     "CompactionResult",
+    "NMError",
+    "NMExecPlan",
     "PropagationGraph",
     "analyze_masks",
     "build_graph",
+    "build_nm_plan",
     "build_plan",
+    "check_divisibility",
     "compact_params",
     "compact_stats",
     "compact_tree",
@@ -56,6 +76,9 @@ __all__ = [
     "expand_stats",
     "expand_train_state",
     "expand_tree",
+    "nm_pattern_inaxis",
+    "nm_pattern_transposable",
+    "project_masks",
     "slice_opt_state",
     "width_signature",
 ]

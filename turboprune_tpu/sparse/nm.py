@@ -1,0 +1,239 @@
+"""N:M projection — snap unstructured masks to hardware-friendly patterns.
+
+The second half of the sparsity-format axis (ROADMAP item 2): channel
+compaction (compact.py) only cashes in when whole channels die; this module
+converts the SCATTERED masks magnitude/ER-ERK pruning actually produces
+into N:M block patterns the gathered execution path (nm_execute.py) can run
+at reduced width.
+
+Pattern semantics — separable N:M, shared across the non-contracted axis:
+a layer's kernel is viewed as a 2D matrix W[I, O] (I = contraction width).
+The projected pattern is ``keep_in ⊗ keep_out`` where ``keep_in`` keeps
+exactly N of every M consecutive rows and (transposable variant only)
+``keep_out`` keeps exactly N of every M consecutive columns. Every output
+column then satisfies N:M along the contraction axis AND — transposable —
+every input row satisfies N:M along the output axis, so the backward
+``dx = dy @ Wᵀ`` contraction is reduced exactly like the forward
+("Accelerated Sparse Neural Training", PAPERS.md). Because the pattern is
+shared across the non-contracted axis, ONE static int32 index map gathers
+the kept weights into dense ``[.., K·N/M]`` tensors — a true reduced-width
+GEMM in pure XLA, which per-column element patterns cannot give.
+
+Projection is monotone (``new_mask = old_mask ∧ pattern``): pruned weights
+never resurrect, so the IMP ladder's global-threshold invariant (scores at
+pruned positions are exactly 0) survives.
+
+Solvers, both batched over blocks with ``vmap``:
+  - greedy (baseline): per-block top-N of row magnitude sums — exact for a
+    single axis.
+  - transposable (TSENOR-style): alternating maximization over
+    (keep_in, keep_out); each half-step is an exact per-block top-N given
+    the other axis, so the preserved magnitude is monotonically
+    non-decreasing from the greedy-both-axes initialization — the final
+    pattern provably preserves >= the greedy baseline (property-tested).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.masking import PyTree, mask_leaves_with_path, path_name
+
+
+class NMError(ValueError):
+    """A layer's geometry cannot take the requested N:M pattern."""
+
+
+# ---------------------------------------------------------------- geometry
+
+
+def split_index(name: str, shape: tuple) -> Optional[int]:
+    """Where the contraction axes of a kernel end: the 2D matmul view is
+    ``(I, O) = (prod(shape[:s]), prod(shape[s:]))``. None = ineligible.
+
+    - Dense kernels (I, O): s=1.
+    - ViT qkv DenseGeneral kernels (D, H, hd): contraction D, s=1.
+    - ViT out-projection kernel (H, hd, D): contraction (H, hd), s=2.
+    - 1x1 conv kernels (1, 1, C, O): contraction C, s=3.
+    - Anything else (spatial convs, embeddings) has no matmul view.
+    """
+    if len(shape) == 2:
+        return 1
+    if len(shape) == 3:
+        # The only 3D kernels in the model zoo are flax-MHA-layout attention
+        # projections; ``out`` contracts its two leading (head) axes.
+        return 2 if name.endswith("out/kernel") else 1
+    if len(shape) == 4 and shape[0] == 1 and shape[1] == 1:
+        return 3
+    return None
+
+
+def _matrix_view(shape: tuple, s: int) -> tuple[int, int]:
+    i = 1
+    for d in shape[:s]:
+        i *= int(d)
+    o = 1
+    for d in shape[s:]:
+        o *= int(d)
+    return i, o
+
+
+def eligible_layers(masks: PyTree) -> list[tuple[str, tuple, int]]:
+    """[(path_name, shape, split)] for every mask leaf with a matmul view."""
+    out = []
+    for path, m in mask_leaves_with_path(masks):
+        name = path_name(path)
+        s = split_index(name, tuple(m.shape))
+        if s is not None:
+            out.append((name, tuple(m.shape), s))
+    return out
+
+
+def check_divisibility(masks: PyTree, m_block: int) -> None:
+    """Fail fast (harness init) when an eligible layer's CONTRACTION width
+    does not divide into M-blocks — a clear error beats a mid-run crash at
+    the first prune step. Non-divisible OUTPUT widths (e.g. 10-class heads)
+    are fine: the projection degrades to input-axis-only there."""
+    for name, shape, s in eligible_layers(masks):
+        i, _ = _matrix_view(shape, s)
+        if i % m_block:
+            raise NMError(
+                f"layer {name!r}: contraction width {i} (kernel shape "
+                f"{shape}) is not divisible by M={m_block} — this layer "
+                f"cannot take an N:{m_block} pattern"
+            )
+
+
+# ----------------------------------------------------------------- solvers
+
+
+def _topn_per_block(scores: jax.Array, n: int, m: int) -> jax.Array:
+    """Exact per-block top-N: bool keep vector with exactly n True per m
+    consecutive entries. Batched over blocks with vmap; lax.top_k breaks
+    ties by first index, so the result is deterministic."""
+    blocks = scores.reshape(-1, m)
+    idx = jax.vmap(lambda row: jax.lax.top_k(row, n)[1])(blocks)
+    keep = jax.vmap(
+        lambda row_idx: jnp.zeros((m,), jnp.bool_).at[row_idx].set(True)
+    )(idx)
+    return keep.reshape(-1)
+
+
+def nm_pattern_inaxis(scores: jax.Array, n: int, m: int) -> jax.Array:
+    """Greedy baseline: keep the N highest-magnitude rows of each M-block,
+    scored by total magnitude across the output axis (exact for one axis).
+    Returns keep_in, bool (I,)."""
+    return _topn_per_block(scores.sum(axis=1), n, m)
+
+
+def nm_pattern_transposable(
+    scores: jax.Array, n: int, m: int, iters: int = 8
+) -> tuple[jax.Array, jax.Array]:
+    """TSENOR-style transposable pattern via alternating maximization.
+
+    Initialized from the greedy both-axes baseline (independent per-axis
+    top-N), then each half-step recomputes one axis's exact per-block top-N
+    restricted to the OTHER axis's kept set. Every half-step maximizes the
+    preserved magnitude given the other axis, so the objective is monotone
+    non-decreasing — the result preserves >= the greedy baseline by
+    construction. Returns (keep_in (I,), keep_out (O,))."""
+    keep_in = _topn_per_block(scores.sum(axis=1), n, m)
+    keep_out = _topn_per_block(scores.sum(axis=0), n, m)
+    for _ in range(iters):
+        keep_in = _topn_per_block(scores @ keep_out.astype(scores.dtype), n, m)
+        keep_out = _topn_per_block(keep_in.astype(scores.dtype) @ scores, n, m)
+    return keep_in, keep_out
+
+
+# -------------------------------------------------------------- projection
+
+
+def project_masks(
+    params: PyTree,
+    masks: PyTree,
+    n: int,
+    m: int,
+    transposable: bool = True,
+) -> tuple[PyTree, dict]:
+    """Project every eligible mask leaf onto its best N:M pattern.
+
+    Scores are |w * mask| (already-pruned weights score 0, so the pattern
+    spends its N-per-block budget on surviving magnitude). The new mask is
+    ``old_mask ∧ (keep_in ⊗ keep_out)`` — monotone, so the IMP ladder's
+    no-resurrection invariant holds. Layers whose OUTPUT width does not
+    divide by M degrade to input-axis-only (recorded in the report);
+    non-divisible CONTRACTION widths raise NMError (check_divisibility
+    fails fast at harness init for exactly this).
+
+    Returns (new_masks, report) where report carries per-layer axes/notes
+    and the preserved-magnitude fraction vs the pre-projection masks.
+    """
+    eligible = {name: (shape, s) for name, shape, s in eligible_layers(masks)}
+    layers: dict[str, dict] = {}
+    mag_before = 0.0
+    mag_after = 0.0
+
+    flat_params = {
+        path_name(p): leaf
+        for p, leaf in jax.tree_util.tree_flatten_with_path(params)[0]
+    }
+
+    def project_leaf(path, mask):
+        nonlocal mag_before, mag_after
+        if mask is None:
+            return None
+        name = path_name(path)
+        if name not in eligible:
+            return mask
+        shape, s = eligible[name]
+        i, o = _matrix_view(shape, s)
+        if i % m:
+            raise NMError(
+                f"layer {name!r}: contraction width {i} not divisible by "
+                f"M={m}"
+            )
+        w = flat_params[name]
+        scores = (
+            jnp.abs(w.astype(jnp.float32)) * mask.astype(jnp.float32)
+        ).reshape(i, o)
+        # Output-axis pattern only when the axis is at least two M-blocks
+        # wide: at o == M the "pattern" would simply delete N out of M
+        # output units outright (for a classifier head: whole class
+        # logits), and the transposable payoff — reduced dx/dw GEMMs — is
+        # negligible at such widths anyway.
+        both_axes = transposable and o % m == 0 and o >= 2 * m
+        if both_axes:
+            keep_in, keep_out = nm_pattern_transposable(scores, n, m)
+        else:
+            keep_in = nm_pattern_inaxis(scores, n, m)
+            keep_out = jnp.ones((o,), jnp.bool_)
+        pattern = keep_in[:, None] & keep_out[None, :]
+        new_mask = mask & pattern.reshape(shape)
+        mag_before += float(scores.sum())
+        mag_after += float(jnp.where(pattern, scores, 0.0).sum())
+        layers[name] = {
+            "numel": int(mask.size),
+            "axes": "both" if both_axes else "in",
+            "note": (
+                ""
+                if both_axes or not transposable
+                else f"output width {o} (M={m}): input-axis-only"
+            ),
+        }
+        return new_mask
+
+    new_masks = jax.tree_util.tree_map_with_path(
+        project_leaf, masks, is_leaf=lambda x: x is None
+    )
+    report = {
+        "pattern": f"{n}:{m}",
+        "transposable": transposable,
+        "layers": layers,
+        "preserved_magnitude_frac": (
+            mag_after / mag_before if mag_before > 0 else 1.0
+        ),
+    }
+    return new_masks, report
