@@ -43,6 +43,14 @@ numbers VERDICT r3/r4 asked for:
                            coverage (unrouted eligible layers listed),
                            forward parity max-abs-diff, and the zero
                            steady-state-recompile count
+  serving_load_*           fleet serving under OPEN-LOOP Poisson load
+                           (serve/fleet/ + serve/loadgen.py): closed-loop
+                           capacity, p50/p99/p99.9 + goodput + sheds per
+                           offered load (0.3x/0.7x/1.5x capacity), the
+                           DETECTED saturation knee (null when the sweep
+                           stayed healthy — never a fake number), and the
+                           per-model execution backends proving
+                           multi-tenant routing; CPU-pinned subprocess
   compaction_s{S}_*        dead-channel compaction sweep (sparse/):
                            vgg16_bn with channel-structured masks at
                            sparsity S% — masked-dense vs compacted eval
@@ -974,6 +982,141 @@ def bench_nm_frontier() -> dict:
     return fields
 
 
+# ----------------------------------------------------------- serving load
+def bench_serving_load() -> dict:
+    """Open-loop load sweep against the FLEET engine (serve/fleet/ +
+    serve/loadgen.py), CPU-pinned subprocess like nm_frontier.
+
+    Builds a 3-level synthetic fleet (dense / channel-structured /
+    2:4-projected — the engines can't tell these apart from trained
+    checkpoints), measures closed-loop capacity, then offers Poisson
+    traffic at 0.3x / 0.7x / 1.5x capacity and reports p50/p99/p99.9,
+    goodput, sheds, and the detected saturation knee. Honesty convention:
+    ``serving_load_knee_rps`` is null when no point saturated — a knee is
+    a DETECTED number, never a default."""
+    import shutil
+    import tempfile
+
+    from turboprune_tpu.config.compose import compose
+    from turboprune_tpu.models import create_model
+    from turboprune_tpu.ops import masking
+    from turboprune_tpu.serve import (
+        AOTExecutableCache,
+        FleetEngine,
+        ModelRegistry,
+        sweep_offered_load,
+    )
+    from turboprune_tpu.sparse import build_graph
+    from turboprune_tpu.sparse.nm import project_masks
+    from turboprune_tpu.train.state import init_variables
+    from turboprune_tpu.utils.checkpoint import (
+        ExperimentCheckpoints,
+        save_model_tree,
+    )
+    from turboprune_tpu.utils.experiment import save_config
+
+    base = Path(tempfile.mkdtemp(prefix="turboprune_fleet_bench_"))
+    fleet = None
+    try:
+        expt_dir = base / "fleet_expt"
+        expt_dir.mkdir()
+        cfg = compose(
+            "cifar10_imp",
+            overrides=[
+                f"experiment_params.base_dir={base}",
+                "experiment_params.training_precision=float32",
+                "dataset_params.dataloader_type=synthetic",
+                "dataset_params.total_batch_size=16",
+                "model_params.model_name=resnet18",
+            ],
+        )
+        save_config(str(expt_dir), cfg)
+        model = create_model("resnet18", 10, "CIFAR10", jnp.float32)
+        variables = init_variables(
+            # graftlint: disable=rng-key-reuse -- synthetic fixture weights; never trained, never compared across seeds
+            model, jax.random.PRNGKey(0), (1, 32, 32, 3)
+        )
+        params = variables["params"]
+        batch_stats = variables.get("batch_stats", {})
+        dense = masking.make_masks(params)
+        graph = build_graph(model, params)
+        channel = _channel_structured_masks(params, graph, 0.5)
+        nm_masks, _ = project_masks(params, dense, 2, 4, transposable=True)
+        ckpts = ExperimentCheckpoints(expt_dir)
+        ckpts.checkpoints_dir.mkdir(parents=True, exist_ok=True)
+        for lvl, masks in enumerate((dense, channel, nm_masks)):
+            save_model_tree(
+                ckpts.level_path(lvl),
+                {
+                    "params": params,
+                    "masks": masks,
+                    "batch_stats": batch_stats,
+                },
+            )
+        fleet = FleetEngine(
+            ModelRegistry([expt_dir]),
+            buckets=(1, 8),
+            max_batch=8,
+            max_wait_ms=2.0,
+            queue_depth=64,
+            aot_cache=AOTExecutableCache(base / "aot"),
+        )
+        rng = np.random.default_rng(0)
+
+        def img(n):
+            return rng.standard_normal((n, 32, 32, 3)).astype(np.float32)
+
+        # Page in + compile every model once: the sweep measures steady
+        # state, and the per-model backends prove real multi-tenancy.
+        backends = {}
+        for model_id in fleet.registry.ids():
+            fleet.predict(img(1), model=model_id, timeout=600)
+        for model_id, row in fleet.info()["models"].items():
+            backends[model_id] = row["backend"]
+
+        # Closed-loop capacity of the default route (rows/s through the
+        # batcher) calibrates the offered-load points.
+        t0 = time.perf_counter()
+        rows = 0
+        while time.perf_counter() - t0 < 2.0:
+            fleet.predict(img(8), timeout=600)
+            rows += 8
+        capacity = rows / (time.perf_counter() - t0)
+
+        probe_future, resident = fleet.submit(img(1))
+        probe_future.result(timeout=600)
+        result = sweep_offered_load(
+            lambda: (lambda: fleet.submit(img(1))[0]),
+            rps_list=[
+                max(1.0, round(capacity * f, 1)) for f in (0.3, 0.7, 1.5)
+            ],
+            duration_s=2.0,
+            seed=0,
+            settle_s=0.5,
+            drain_timeout_s=20.0,
+            depth_probe=lambda: resident.batcher.queue_depth,
+        )
+        points = [
+            {
+                k: (round(v, 2) if isinstance(v, float) else v)
+                for k, v in p.items()
+            }
+            for p in result["points"]
+        ]
+        return {
+            "serving_load_capacity_rps": round(capacity, 1),
+            "serving_load_models": backends,
+            "serving_load_points": points,
+            # null (never 0.0) when the sweep stayed healthy end-to-end
+            "serving_load_knee_rps": result["knee_rps"],
+            "serving_load_saturated": result["saturated"],
+        }
+    finally:
+        if fleet is not None:
+            fleet.close()
+        shutil.rmtree(base, ignore_errors=True)
+
+
 # ------------------------------------------------------- flash attention
 def bench_flash_attention() -> dict:
     """Pallas flash vs dense attention, fwd+bwd, on the REAL chip — the
@@ -1340,6 +1483,29 @@ def main() -> None:
         )
 
     run_stage("nm_frontier", stage_nm_frontier)
+
+    def stage_serving_load() -> dict:
+        """CPU-pinned SUBPROCESS like nm_frontier: the open-loop sweep
+        measures the serving stack on host CPU by definition, so a dead
+        accelerator tunnel must not block it."""
+        import subprocess
+
+        out = subprocess.run(
+            [sys.executable, str(Path(__file__).resolve()), "--serving-load"],
+            capture_output=True,
+            text=True,
+            cwd=str(Path(__file__).resolve().parent),
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            timeout=600,
+        )
+        for line in out.stdout.splitlines():
+            if line.startswith("SERVING_LOAD "):
+                return json.loads(line[len("SERVING_LOAD "):])
+        raise RuntimeError(
+            f"serving_load subprocess failed: {out.stderr[-400:]}"
+        )
+
+    run_stage("serving_load", stage_serving_load)
     extra["pipeline_host_cpu_cores"] = os.cpu_count()
 
     _partial["done"] = True  # fire() checks this — cancel can lose the race
@@ -1351,5 +1517,8 @@ if __name__ == "__main__":
     if "--nm-frontier" in sys.argv:
         # Child mode for the nm_frontier stage (CPU-pinned by the parent).
         print("NM_FRONTIER " + json.dumps(bench_nm_frontier()), flush=True)
+    elif "--serving-load" in sys.argv:
+        # Child mode for the serving_load stage (CPU-pinned by the parent).
+        print("SERVING_LOAD " + json.dumps(bench_serving_load()), flush=True)
     else:
         main()

@@ -19,7 +19,14 @@
 #      the dense step functions with one cached executable, stale plans
 #      evict, and compact_train composes. Isolated so an N:M regression
 #      is named before the full suite runs.
-#   5. tier-1 fast tests        — the same command ROADMAP.md pins,
+#   5. serving-load smoke       — the fleet serving drain + open-loop
+#      load generator on a jax-free fake engine: graceful drain answers
+#      in-flight work then sheds, and the Poisson sweep finds the
+#      saturation knee at the overloaded point, not the healthy one.
+#      Isolated (and jax-light, so it's fast) because loadgen bugs
+#      otherwise surface as flaky latency numbers in BENCH, not as a
+#      named failure.
+#   6. tier-1 fast tests        — the same command ROADMAP.md pins,
 #      including its plugin surface (-p no:xdist -p no:randomly), so the
 #      gate and tier-1 agree on what "the suite" is.
 # Exits nonzero if any stage fails. Run from anywhere: paths resolve
@@ -41,6 +48,12 @@ JAX_PLATFORMS=cpu python -m pytest \
 echo "== nm smoke (gathered N:M lifecycle on synthetic .tpk) =="
 JAX_PLATFORMS=cpu python -m pytest \
     tests/test_nm.py::TestHarnessNMSmoke -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
+echo "== serving-load smoke (drain + open-loop knee, fake engine) =="
+JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_fleet.py::TestGracefulDrain \
+    tests/test_fleet.py::TestLoadgen -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
 echo "== tier-1 tests (fast tier, CPU) =="

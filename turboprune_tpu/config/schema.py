@@ -359,6 +359,58 @@ class OptimizerConfig:
             raise ConfigError("warmup_fraction must be in [0, 1]")
 
 
+# Fleet request routing when a request carries no "model" field: the
+# sparsest (latest) level, the dense (lowest) level, or a pinned id.
+FLEET_ROUTES = ("latest", "dense", "pinned")
+# Per-checkpoint execution backend: auto picks compact when dead channels
+# actually shrink the model, else nm when the plan routes a layer, else
+# masked-dense.
+FLEET_BACKENDS = ("auto", "masked", "compact", "nm")
+
+
+@dataclass
+class FleetConfig:
+    """Multi-checkpoint tenancy (serve/fleet/): serve every saved level of
+    one or more experiment dirs from one process, routed on the request's
+    ``model`` field."""
+
+    # Experiment dirs to scan; empty = fall back to serve.expt_dir.
+    expt_dirs: list = field(default_factory=list)
+    # Weight-paging budget: at most this many models hold weights and
+    # compiled executables at once (LRU eviction beyond it).
+    max_resident_models: int = 4
+    # Directory for serialized AOT executables ("" = disabled): cold start
+    # becomes load-not-compile. Safe to share between replicas; entries from
+    # a different jax/jaxlib/backend are bypassed, corrupt ones quarantined.
+    aot_cache_dir: str = ""
+    # Data-parallel lanes per model: engines round-robin flushed
+    # micro-batches across devices when present, threads on CPU.
+    replicas: int = 1
+    default_route: str = "latest"
+    # Registry id to serve when default_route=pinned (e.g. "level_3").
+    pinned_model: str = ""
+    backend: str = "auto"
+
+    def validate(self) -> None:
+        _check_choice(
+            "serve.fleet.default_route", self.default_route, FLEET_ROUTES
+        )
+        _check_choice("serve.fleet.backend", self.backend, FLEET_BACKENDS)
+        if self.max_resident_models < 1:
+            raise ConfigError("serve.fleet.max_resident_models must be >= 1")
+        if self.replicas < 1:
+            raise ConfigError("serve.fleet.replicas must be >= 1")
+        if self.default_route == "pinned" and not self.pinned_model:
+            raise ConfigError(
+                "serve.fleet.default_route=pinned needs serve.fleet.pinned_model"
+            )
+        if self.pinned_model and self.default_route != "pinned":
+            raise ConfigError(
+                "serve.fleet.pinned_model is set but default_route is "
+                f"{self.default_route!r} — set default_route=pinned or drop it"
+            )
+
+
 @dataclass
 class ServeConfig:
     """Inference-serving knobs (serve/ subsystem; composed from conf/serve/).
@@ -396,8 +448,18 @@ class ServeConfig:
     # masks contain dead channels, not scattered zeros (README "Sparsity
     # execution").
     compact: bool = False
+    # Graceful-shutdown budget: on SIGTERM the server stops accepting and
+    # answers already-accepted requests for up to this long before exiting.
+    drain_timeout_s: float = 10.0
+    # Fleet serving (serve/fleet/): present = serve every level of the
+    # configured experiment dirs from this one process.
+    fleet: Optional[FleetConfig] = None
 
     def validate(self) -> None:
+        if self.drain_timeout_s < 0:
+            raise ConfigError("serve.drain_timeout_s must be >= 0")
+        if self.fleet is not None:
+            self.fleet.validate()
         if not self.batch_buckets:
             raise ConfigError("serve.batch_buckets must be non-empty")
         buckets = list(self.batch_buckets)
@@ -566,6 +628,7 @@ _NESTED = {
     "CyclicTrainingConfig": CyclicTrainingConfig,
     "ResumeExperimentConfig": ResumeExperimentConfig,
     "ServeConfig": ServeConfig,
+    "FleetConfig": FleetConfig,
 }
 
 

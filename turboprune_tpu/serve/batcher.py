@@ -1,4 +1,4 @@
-"""DynamicBatcher — micro-batching queue between callers and the engine.
+"""DynamicBatcher — micro-batching queue between callers and the engine(s).
 
 Requests (each a [k, H, W, C] float array, k >= 1) land on a BOUNDED queue
 (backpressure: a full queue rejects with QueueFullError so the HTTP layer
@@ -10,6 +10,21 @@ The concatenated rows go through ``engine.predict`` (which pads to the
 compiled bucket) and each caller's Future receives exactly its own rows
 back.
 
+Data-parallel replicas: construct with a LIST of engines (one per device,
+built under ``jax.default_device``) and/or ``replicas=K`` — flushed
+micro-batches round-robin across the engines on a K-thread pool, so one
+collector feeds K concurrent forwards. On CPU the engines list is usually a
+single engine shared by K threads (XLA executables are thread-safe), which
+overlaps the numpy pack/unpack of one batch with the compute of another.
+With ``replicas=1`` (the default) the flush stays inline in the worker
+thread — the exact pre-fleet behavior.
+
+Graceful shutdown: ``drain(deadline_s)`` stops admitting work (new submits
+are rejected like a full queue), waits until every already-accepted request
+has been answered or the deadline passes, then closes. SIGTERM handling in
+run_server.py goes through this, so a rolling restart answers its in-flight
+requests instead of dropping them.
+
 Latency recorded per request is submit -> result (queue wait + batching
 wait + padded forward), i.e. what a caller actually experiences.
 """
@@ -19,14 +34,14 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Optional
 
 import numpy as np
 
 
 class QueueFullError(RuntimeError):
-    """Bounded request queue is full — shed load (HTTP 503)."""
+    """Bounded request queue is full (or draining) — shed load (HTTP 503)."""
 
 
 class _Request:
@@ -47,15 +62,35 @@ class DynamicBatcher:
         max_wait_ms: float = 5.0,
         queue_depth: int = 256,
         metrics=None,
+        replicas: int = 1,
     ):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
-        self.engine = engine
+        if replicas < 1:
+            raise ValueError("replicas must be >= 1")
+        engines = list(engine) if isinstance(engine, (list, tuple)) else [engine]
+        if not engines:
+            raise ValueError("need at least one engine")
+        self.engine = engines[0]  # primary (shape validation, info)
+        self._engines = engines
+        self._workers = max(int(replicas), len(engines))
+        self._pool = (
+            ThreadPoolExecutor(
+                max_workers=self._workers,
+                thread_name_prefix="turboprune-replica",
+            )
+            if self._workers > 1
+            else None
+        )
+        self._rr = 0  # round-robin cursor over engines (worker thread only)
         self.max_batch = int(max_batch)
         self.max_wait_s = float(max_wait_ms) / 1e3
         self.metrics = metrics
         self._queue: queue.Queue[_Request] = queue.Queue(maxsize=queue_depth)
         self._stop = threading.Event()
+        self._draining = False
+        self._outstanding = 0  # accepted but unanswered requests
+        self._outstanding_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
 
     # ----------------------------------------------------------- lifecycle
@@ -68,7 +103,26 @@ class DynamicBatcher:
             self._thread.start()
         return self
 
+    def drain(self, deadline_s: float = 10.0) -> dict:
+        """Graceful shutdown: reject new submits, answer everything already
+        accepted (queued or mid-flush) within ``deadline_s``, then close.
+        Returns {"drained": bool, "unanswered": n} — unanswered requests
+        past the deadline get the close-time RuntimeError."""
+        self._draining = True
+        deadline = time.perf_counter() + max(0.0, float(deadline_s))
+        while time.perf_counter() < deadline:
+            with self._outstanding_lock:
+                n = self._outstanding
+            if n == 0:
+                break
+            time.sleep(0.005)
+        with self._outstanding_lock:
+            unanswered = self._outstanding
+        self.close()
+        return {"drained": unanswered == 0, "unanswered": unanswered}
+
     def close(self, timeout: float = 5.0) -> None:
+        self._draining = True
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout)
@@ -78,16 +132,32 @@ class DynamicBatcher:
                 req = self._queue.get_nowait()
             except queue.Empty:
                 break
-            req.future.set_exception(RuntimeError("batcher closed"))
+            self._finish(req, error=RuntimeError("batcher closed"))
+        if self._pool is not None:
+            # In-flight replica flushes resolve their own futures; wait so
+            # close() returning means no thread still touches the engines.
+            self._pool.shutdown(wait=True)
+            self._pool = None
 
     @property
     def queue_depth(self) -> int:
         return self._queue.qsize()
 
+    @property
+    def outstanding(self) -> int:
+        """Accepted-but-unanswered requests (queued + mid-flush)."""
+        with self._outstanding_lock:
+            return self._outstanding
+
     # ------------------------------------------------------------- clients
     def submit(self, images: np.ndarray) -> Future:
         """Enqueue one request; returns a Future resolving to its logits.
-        Raises QueueFullError when the bounded queue is at capacity."""
+        Raises QueueFullError when the bounded queue is at capacity or the
+        batcher is draining."""
+        if self._draining or self._stop.is_set():
+            if self.metrics:
+                self.metrics.inc("rejected_total")
+            raise QueueFullError("batcher is draining — shed load")
         x = np.asarray(images, np.float32)
         if x.ndim == len(self.engine.input_shape):
             x = x[None]
@@ -101,9 +171,13 @@ class DynamicBatcher:
                 f" with k >= 1, got {x.shape}"
             )
         req = _Request(x, Future(), time.perf_counter())
+        with self._outstanding_lock:
+            self._outstanding += 1
         try:
             self._queue.put_nowait(req)
         except queue.Full:
+            with self._outstanding_lock:
+                self._outstanding -= 1
             if self.metrics:
                 self.metrics.inc("rejected_total")
             raise QueueFullError(
@@ -139,28 +213,41 @@ class DynamicBatcher:
                 rows += nxt.images.shape[0]
             if self.metrics:
                 self.metrics.set_gauge("queue_depth", self._queue.qsize())
-            self._flush(batch, rows)
+            if self._pool is not None:
+                eng = self._engines[self._rr % len(self._engines)]
+                self._rr += 1
+                self._pool.submit(self._flush, batch, rows, eng)
+            else:
+                self._flush(batch, rows, self.engine)
 
-    def _flush(self, batch: list[_Request], rows: int) -> None:
+    def _finish(self, req: _Request, result=None, error=None) -> None:
+        if error is not None:
+            req.future.set_exception(error)
+        else:
+            req.future.set_result(result)
+        with self._outstanding_lock:
+            self._outstanding -= 1
+
+    def _flush(self, batch: list[_Request], rows: int, engine) -> None:
         images = (
             batch[0].images
             if len(batch) == 1
             else np.concatenate([r.images for r in batch])
         )
         try:
-            logits = self.engine.predict(images)
+            logits = engine.predict(images)
         # graftlint: disable=broad-except -- degrade-don't-die: the error is delivered to every caller via future.set_exception and counted in errors_total; the batcher thread must survive any engine failure
         except Exception as e:  # surface to every caller, keep serving
             if self.metrics:
                 self.metrics.inc("errors_total", len(batch))
             for req in batch:
-                req.future.set_exception(e)
+                self._finish(req, error=e)
             return
         done = time.perf_counter()
         offset = 0
         for req in batch:
             k = req.images.shape[0]
-            req.future.set_result(logits[offset : offset + k])
+            self._finish(req, result=logits[offset : offset + k])
             offset += k
             if self.metrics:
                 self.metrics.observe_latency_ms((done - req.t_submit) * 1e3)

@@ -11,6 +11,17 @@ is padded up to the smallest bucket >= n (split at the largest bucket), so
 at steady state no request ever triggers a fresh XLA trace. Compile-cache
 hits/misses are reported through ServeMetrics.
 
+A checkpoint can execute through three backends (``backend=``): ``masked``
+(fold ``w * m`` once, serve dense), ``compact`` (slice dead channels,
+physically smaller HLO), or ``nm`` (gather N:M-surviving rows through the
+sparse/nm_execute.py index plan — masks are folded first, so the gathered
+forward reads exact already-masked weights). ``auto`` picks per checkpoint:
+compact when channel sparsity actually shrinks the model, else nm when the
+plan routes any layer, else masked. With an ``aot_cache``
+(serve/fleet/aot_cache.py) each bucket's compiled executable is looked up
+on disk before invoking XLA — ``xla_compiles_total`` counts only REAL
+compiles, so a warm cache provably makes construction compile-free.
+
 Serving is single-process/single-program by design — the training-side mesh
 machinery (sharded steps, multihost barriers) is deliberately not involved;
 model-parallel attention impls (ring) fall back to their dense equivalent,
@@ -39,6 +50,23 @@ from ..utils.checkpoint import ExperimentCheckpoints, restore_model_tree
 DEFAULT_BUCKETS = (1, 8, 32, 128)
 
 
+def _clone_factory(model):
+    """Default model re-instantiation for compact/nm backends: clone the
+    module with normalized (hashable) override tuples."""
+
+    def factory(width_overrides=None, nm_overrides=None):
+        kw = {}
+        if width_overrides:
+            kw["width_overrides"] = tuple(
+                sorted(dict(width_overrides).items())
+            )
+        if nm_overrides:
+            kw["nm_overrides"] = tuple(sorted(dict(nm_overrides).items()))
+        return model.clone(**kw)
+
+    return factory
+
+
 class InferenceEngine:
     """Bucketed, mask-folded forward over a loaded checkpoint.
 
@@ -59,6 +87,8 @@ class InferenceEngine:
         source: str = "",
         compact: bool = False,
         model_factory=None,
+        backend: Optional[str] = None,
+        aot_cache=None,
     ):
         self.model = model
         self.buckets = tuple(sorted({int(b) for b in buckets}))
@@ -66,11 +96,21 @@ class InferenceEngine:
             raise ValueError(f"buckets must be positive ints, got {buckets!r}")
         self.input_shape = tuple(int(d) for d in input_shape)
         self.metrics = metrics
+        self.aot_cache = aot_cache
         self.level = level
         self.source = source
         self.density = masking.overall_density(masks)
         self.compaction: Optional[dict] = None
-        if compact:
+        self.nm_plan_report: Optional[dict] = None
+        if backend is None:
+            backend = "compact" if compact else "masked"
+        if backend not in ("masked", "compact", "nm", "auto"):
+            raise ValueError(f"unknown serving backend {backend!r}")
+        factory = model_factory or _clone_factory(model)
+        if backend == "auto":
+            backend = self._pick_backend(model, params, masks, batch_stats)
+        self.backend = backend
+        if backend == "compact":
             # Dead-channel compaction (sparse/): slice all-zero fan-out
             # channels out of the checkpoint and serve the physically
             # smaller model — the AOT lower below then compiles the smaller
@@ -80,18 +120,44 @@ class InferenceEngine:
 
             graph = build_graph(model, params)
             result = compact_params(params, masks, graph, batch_stats)
-            factory = model_factory or (
-                lambda ov: model.clone(
-                    width_overrides=tuple(sorted(ov.items()))
-                )
-            )
-            self.model = factory(result.width_overrides)
+            self.model = factory(width_overrides=result.width_overrides)
             self.compaction = result.report
             self._variables = {"params": result.params}
             if result.batch_stats:
                 self._variables["batch_stats"] = result.batch_stats
             if metrics:
                 metrics.record_compaction(result.report)
+            self._plan_signature = (
+                "compact",
+                tuple(sorted(dict(result.width_overrides).items())),
+            )
+        elif backend == "nm":
+            # Gathered N:M execution (sparse/nm_execute.py): fold masks
+            # first — NM modules read raw kernel rows, so the folded params
+            # ARE the masked weights — then route eligible layers through
+            # static gather index maps. Unroutable checkpoints (no layer
+            # clears the savings bar) degrade honestly to masked.
+            from ..sparse.nm_execute import build_nm_plan
+
+            folded = masking.apply_masks(params, masks)
+            plan = build_nm_plan(model, masks)
+            if plan.overrides:
+                self.model = factory(nm_overrides=plan.overrides)
+                self.nm_plan_report = {
+                    "routed_layers": len(plan.overrides),
+                    "coverage_frac": plan.report["coverage_frac"],
+                    "eligible_params": plan.report["eligible_params"],
+                    "routed_params": plan.report["routed_params"],
+                }
+                if metrics:
+                    metrics.record_nm(self.nm_plan_report)
+                self._plan_signature = ("nm", plan.as_override_tuple())
+            else:
+                self.backend = "masked"
+                self._plan_signature = ("masked",)
+            self._variables = {"params": folded}
+            if batch_stats:
+                self._variables["batch_stats"] = batch_stats
         else:
             # Fold once: pruned weights become literal zeros in the served
             # params, so per-request forwards skip the mask multiply
@@ -100,9 +166,30 @@ class InferenceEngine:
             self._variables = {"params": folded}
             if batch_stats:
                 self._variables["batch_stats"] = batch_stats
+            self._plan_signature = ("masked",)
         self.num_classes = None  # set by the first compile (output aval)
         self._compiled: dict[int, Any] = {}
         self._compile_lock = threading.Lock()
+
+    @staticmethod
+    def _pick_backend(model, params, masks, batch_stats) -> str:
+        """auto: compact when dead channels actually shrink the model, else
+        nm when the plan routes at least one layer, else masked. The real
+        batch_stats must be probed too — compaction slices attached BN
+        stats, so an empty tree would fail the probe for every BN model."""
+        from ..sparse import CompactionError, build_graph, compact_params
+        from ..sparse.nm_execute import build_nm_plan
+
+        try:
+            graph = build_graph(model, params)
+            result = compact_params(params, masks, graph, batch_stats or {})
+            if result.report["params_after"] < result.report["params_before"]:
+                return "compact"
+        except CompactionError:
+            pass  # architecture without a compaction graph — try nm
+        if build_nm_plan(model, masks).overrides:
+            return "nm"
+        return "masked"
 
     # ----------------------------------------------------------- compiling
     def _apply(self, variables, images):
@@ -130,7 +217,25 @@ class InferenceEngine:
             t0 = time.perf_counter()
             # graftlint: disable=retrace-hazard -- AOT by design: lower() runs once per bucket shape, guarded by the _compiled cache + _compile_lock double-check above
             lowered = jax.jit(self._apply).lower(self._variables, spec)
-            fn = lowered.compile()
+            fn = None
+            key = None
+            if self.aot_cache is not None:
+                # Persistent layer: tracing (above) is cheap; the expensive
+                # XLA compile is what the on-disk executable replaces.
+                key = self.aot_cache.make_key(
+                    hlo_fingerprint=self.aot_cache.fingerprint(lowered),
+                    plan_signature=self._plan_signature,
+                    bucket=bucket,
+                )
+                fn, status = self.aot_cache.load(key)
+                if self.metrics:
+                    self.metrics.inc(f"aot_cache_{status}_total")
+            if fn is None:
+                fn = lowered.compile()
+                if self.metrics:
+                    self.metrics.inc("xla_compiles_total")
+                if key is not None:
+                    self.aot_cache.store(key, fn)
             if self.metrics:
                 self.metrics.inc(
                     "compile_seconds_total", time.perf_counter() - t0
@@ -190,12 +295,15 @@ class InferenceEngine:
         out = {
             "level": self.level,
             "density": round(float(self.density), 6),
+            "backend": self.backend,
             "buckets": list(self.buckets),
             "compiled_buckets": list(self.compiled_buckets),
             "input_shape": list(self.input_shape),
             "num_classes": self.num_classes,
             "source": self.source,
         }
+        if self.nm_plan_report is not None:
+            out["nm"] = dict(self.nm_plan_report)
         if self.compaction is not None:
             out["compaction"] = {
                 "params_before": self.compaction["params_before"],
@@ -218,6 +326,8 @@ class InferenceEngine:
         metrics=None,
         precision: Optional[str] = None,
         compact: bool = False,
+        backend: Optional[str] = None,
+        aot_cache=None,
     ) -> "InferenceEngine":
         """Build from an experiment directory written by the driver.
 
@@ -290,14 +400,19 @@ class InferenceEngine:
             level=level,
             source=str(path),
             compact=compact,
-            # Re-instantiate through create_model so the compacted model
-            # gets the exact same stem/dtype/attention wiring.
-            model_factory=lambda ov: create_model(
-                cfg.model_params.model_name,
-                num_classes=dp.num_classes,
-                dataset_name=dp.dataset_name,
-                compute_dtype=dtype,
-                attention_impl=attention_impl,
-                width_overrides=ov,
+            backend=backend,
+            aot_cache=aot_cache,
+            # Re-instantiate through create_model so the compacted/gathered
+            # model gets the exact same stem/dtype/attention wiring.
+            model_factory=lambda width_overrides=None, nm_overrides=None: (
+                create_model(
+                    cfg.model_params.model_name,
+                    num_classes=dp.num_classes,
+                    dataset_name=dp.dataset_name,
+                    compute_dtype=dtype,
+                    attention_impl=attention_impl,
+                    width_overrides=width_overrides,
+                    nm_overrides=nm_overrides,
+                )
             ),
         )

@@ -1,0 +1,184 @@
+"""Persistent on-disk cache of serialized AOT executables.
+
+The XLA persistent compilation cache is unusable in this environment — its
+read path segfaults the process (CHANGES PR 1), so it is force-disabled in
+tests/conftest.py and cold start has meant a full re-compile of every
+(model, bucket) pair on every restart. This module is our own, much
+narrower layer: after ``jit(...).lower(...).compile()`` the compiled
+executable is serialized with ``jax.experimental.serialize_executable``
+(payload + in/out pytree defs) and written to one file per key; a later
+process deserializes it and serves without ever invoking the compiler
+(verified cross-process: load is ~30 ms where the compile was seconds).
+
+Keying: the filename hash covers the semantic identity of the computation —
+HLO fingerprint (sha256 of the lowered StableHLO text), the execution-plan
+signature (compacted widths / N:M plan digest / masked), and the batch
+bucket. The environment identity (jax, jaxlib, backend) is stored in the
+entry's metadata and CHECKED at load: a mismatch is a "bypass" (the entry
+is ignored and later overwritten by the current environment's store), never
+a crash and never a silent wrong-executable hit. Unreadable or truncated
+entries are quarantined (renamed ``*.quarantined``) and counted, so one
+corrupt file degrades to a single cold compile instead of taking the
+process down — the exact failure mode the XLA cache has here.
+
+Writes are atomic (tmp file + rename) so concurrent replicas sharing a
+cache directory never observe torn entries.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import threading
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+
+_FORMAT_VERSION = 1
+_SUFFIX = ".aotx"
+
+# Load statuses (also the counter keys, exported via stats()).
+HIT = "hit"
+MISS = "miss"
+BYPASS = "bypass"
+CORRUPT = "corrupt"
+
+
+def _env_meta() -> dict:
+    import jaxlib
+
+    return {
+        "format": _FORMAT_VERSION,
+        "jax": jax.__version__,
+        "jaxlib": getattr(jaxlib, "__version__", "unknown"),
+        "backend": jax.default_backend(),
+    }
+
+
+class AOTExecutableCache:
+    """Directory of serialized executables; thread-safe, shared fleet-wide."""
+
+    def __init__(self, cache_dir: str | Path):
+        self.dir = Path(cache_dir)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self._lock = threading.Lock()
+        self._counters = {HIT: 0, MISS: 0, BYPASS: 0, CORRUPT: 0, "stores": 0}
+
+    # --------------------------------------------------------------- keying
+    @staticmethod
+    def fingerprint(lowered) -> str:
+        """HLO fingerprint of a ``jax.jit(...).lower(...)`` result."""
+        return hashlib.sha256(lowered.as_text().encode()).hexdigest()
+
+    def make_key(
+        self,
+        *,
+        hlo_fingerprint: str,
+        plan_signature: Any = ("masked",),
+        bucket: int = 0,
+    ) -> str:
+        blob = json.dumps(
+            {
+                "hlo": hlo_fingerprint,
+                "plan": repr(plan_signature),
+                "bucket": int(bucket),
+            },
+            sort_keys=True,
+        )
+        return hashlib.sha256(blob.encode()).hexdigest()[:40]
+
+    def _path(self, key: str) -> Path:
+        return self.dir / f"{key}{_SUFFIX}"
+
+    # ---------------------------------------------------------------- load
+    def load(self, key: str):
+        """Returns ``(compiled_or_None, status)`` with status one of
+        hit/miss/bypass/corrupt. Never raises on a bad entry."""
+        path = self._path(key)
+        if not path.exists():
+            return None, self._count(MISS)
+        try:
+            entry = pickle.loads(path.read_bytes())
+            meta = entry["meta"]
+        # graftlint: disable=broad-except -- degrade-don't-die: any unreadable/truncated/hostile entry must quarantine to a cold compile, not crash the serving process (the XLA cache's failure mode here)
+        except Exception:
+            self._quarantine(path)
+            return None, self._count(CORRUPT)
+        env = _env_meta()
+        if any(meta.get(k) != env[k] for k in env):
+            # Built by a different jax/jaxlib/backend — executables are not
+            # portable across those, so ignore it; the caller compiles and
+            # store() overwrites with the current environment's build.
+            return None, self._count(BYPASS)
+        try:
+            from jax.experimental import serialize_executable
+
+            compiled = serialize_executable.deserialize_and_load(
+                entry["payload"], entry["in_tree"], entry["out_tree"]
+            )
+        # graftlint: disable=broad-except -- degrade-don't-die: deserialization failures (e.g. CPU-feature mismatch surfacing as XlaRuntimeError) must also degrade to a compile
+        except Exception:
+            self._quarantine(path)
+            return None, self._count(CORRUPT)
+        return compiled, self._count(HIT)
+
+    # --------------------------------------------------------------- store
+    def store(self, key: str, compiled) -> bool:
+        """Serialize + atomically write; returns False (counted nowhere
+        fatal) when the executable refuses to serialize."""
+        try:
+            from jax.experimental import serialize_executable
+
+            payload, in_tree, out_tree = serialize_executable.serialize(
+                compiled
+            )
+        # graftlint: disable=broad-except -- degrade-don't-die: an unserializable executable just means this entry stays cold; serving correctness is unaffected
+        except Exception:
+            with self._lock:
+                self._counters["store_failed"] = (
+                    self._counters.get("store_failed", 0) + 1
+                )
+            return False
+        entry = {
+            "meta": _env_meta(),
+            "payload": payload,
+            "in_tree": in_tree,
+            "out_tree": out_tree,
+        }
+        path = self._path(key)
+        tmp = path.with_suffix(f".tmp.{os.getpid()}.{threading.get_ident()}")
+        tmp.write_bytes(pickle.dumps(entry))
+        os.replace(tmp, path)
+        with self._lock:
+            self._counters["stores"] += 1
+        return True
+
+    # ------------------------------------------------------------ plumbing
+    def _count(self, status: str) -> str:
+        with self._lock:
+            self._counters[status] = self._counters.get(status, 0) + 1
+        return status
+
+    def _quarantine(self, path: Path) -> None:
+        try:
+            os.replace(path, path.with_suffix(".quarantined"))
+        except OSError:
+            pass  # already moved by a racing loader, or dir went away
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self._counters)
+        out["entries"] = len(list(self.dir.glob(f"*{_SUFFIX}")))
+        out["quarantined"] = len(list(self.dir.glob("*.quarantined")))
+        out["dir"] = str(self.dir)
+        return out
+
+
+def open_cache(cache_dir: str | Path | None) -> Optional[AOTExecutableCache]:
+    """'' / None disables the persistent layer (in-memory buckets only)."""
+    if not cache_dir:
+        return None
+    return AOTExecutableCache(cache_dir)

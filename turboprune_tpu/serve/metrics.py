@@ -7,6 +7,14 @@ depth, per-request latency), and the HTTP server (the /metrics endpoint).
 All mutation goes through one lock — the batcher worker, N HTTP handler
 threads, and the engine's compile path all write concurrently.
 
+Multi-model (fleet) serving attaches a label set to each instance
+(``labels=(("model", "level_3"),)``) and renders every instance through one
+``MetricsHub``: samples are grouped by metric NAME across instances so the
+exposition carries exactly one ``# TYPE`` line per metric with one labelled
+sample per model — two engines exporting ``compaction_params_dense`` are
+distinct series, not a silent overwrite (the PR 11 collision fix; regression
+test in tests/test_fleet.py).
+
 Quantiles (p50/p99) are computed from a bounded sliding window of recent
 latencies rather than from the histogram buckets: the window gives exact
 recent-traffic quantiles for the JSON snapshot/bench, while the cumulative
@@ -19,7 +27,7 @@ from __future__ import annotations
 import bisect
 import threading
 from collections import deque
-from typing import Optional
+from typing import Iterable, Optional, Sequence
 
 # Upper bounds (ms) of the cumulative latency histogram; +Inf is implicit.
 LATENCY_BUCKETS_MS = (
@@ -29,8 +37,24 @@ LATENCY_BUCKETS_MS = (
 _PREFIX = "turboprune_serve_"
 
 
+def _escape_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _label_str(pairs: Sequence[tuple[str, str]]) -> str:
+    if not pairs:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label(str(v))}"' for k, v in pairs)
+    return "{" + inner + "}"
+
+
 class ServeMetrics:
-    def __init__(self, window: int = 4096):
+    def __init__(
+        self,
+        window: int = 4096,
+        labels: Sequence[tuple[str, str]] = (),
+    ):
+        self.labels = tuple((str(k), str(v)) for k, v in labels)
         self._lock = threading.Lock()
         self._counters: dict[str, float] = {}
         self._gauges: dict[str, float] = {}
@@ -69,6 +93,13 @@ class ServeMetrics:
         )
         self.set_gauge("compaction_spaces_compacted", report["compacted_spaces"])
 
+    def record_nm(self, report: dict) -> None:
+        """Export the gathered N:M execution outcome (sparse/nm_execute.py):
+        how much of the matmul-heavy weight mass actually routes through the
+        gathered path, so "served as N:M" is an observable claim."""
+        self.set_gauge("nm_routed_layers", report.get("routed_layers", 0))
+        self.set_gauge("nm_coverage_frac", report.get("coverage_frac", 0.0))
+
     def observe_latency_ms(self, ms: float) -> None:
         with self._lock:
             i = bisect.bisect_left(LATENCY_BUCKETS_MS, ms)
@@ -91,6 +122,10 @@ class ServeMetrics:
     def counter(self, name: str) -> float:
         with self._lock:
             return self._counters.get(name, 0.0)
+
+    def gauge(self, name: str) -> Optional[float]:
+        with self._lock:
+            return self._gauges.get(name)
 
     def latency_quantile_ms(self, q: float) -> Optional[float]:
         """Exact quantile over the recent-latency window; None when empty."""
@@ -120,37 +155,120 @@ class ServeMetrics:
             snap["mean_batch_rows"] = sum(batch_window) / len(batch_window)
         return snap
 
+    def _raw(self) -> dict:
+        """Consistent snapshot of everything the renderer needs."""
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "latency_counts": list(self._latency_counts),
+                "latency_sum_ms": self._latency_sum_ms,
+                "latency_total": self._latency_total,
+            }
+
     def render_prometheus(self) -> str:
         """Prometheus text exposition (version 0.0.4)."""
-        with self._lock:
-            counters = sorted(self._counters.items())
-            gauges = sorted(self._gauges.items())
-            counts = list(self._latency_counts)
-            lat_sum = self._latency_sum_ms
-            total = self._latency_total
-        lines = []
-        for name, value in counters:
-            lines.append(f"# TYPE {_PREFIX}{name} counter")
-            lines.append(f"{_PREFIX}{name} {_fmt(value)}")
-        for name, value in gauges:
-            lines.append(f"# TYPE {_PREFIX}{name} gauge")
-            lines.append(f"{_PREFIX}{name} {_fmt(value)}")
+        return render_prometheus_all([self])
+
+
+def render_prometheus_all(instances: Iterable["ServeMetrics"]) -> str:
+    """Render N metric instances (typically one per served model) as ONE
+    exposition: samples are grouped by metric name so each name gets exactly
+    one ``# TYPE`` line with one labelled sample per instance — the spec
+    forbids repeating TYPE for a name, which is what naively concatenating
+    per-model renders would do."""
+    # name -> {"kind": ..., "lines": [...]}; insertion order preserved so
+    # related series stay adjacent.
+    series: dict[str, dict] = {}
+
+    def add(name: str, kind: str, line: str) -> None:
+        s = series.setdefault(name, {"kind": kind, "lines": []})
+        s["lines"].append(line)
+
+    for m in instances:
+        raw = m._raw()
+        lbl = _label_str(m.labels)
+        for name, value in sorted(raw["counters"].items()):
+            add(name, "counter", f"{_PREFIX}{name}{lbl} {_fmt(value)}")
+        for name, value in sorted(raw["gauges"].items()):
+            add(name, "gauge", f"{_PREFIX}{name}{lbl} {_fmt(value)}")
         hist = f"{_PREFIX}request_latency_ms"
-        lines.append(f"# TYPE {hist} histogram")
         running = 0
-        for le, c in zip(LATENCY_BUCKETS_MS, counts):
+        for le, c in zip(LATENCY_BUCKETS_MS, raw["latency_counts"]):
             running += c
-            lines.append(f'{hist}_bucket{{le="{_fmt(le)}"}} {running}')
-        lines.append(f'{hist}_bucket{{le="+Inf"}} {total}')
-        lines.append(f"{hist}_sum {_fmt(lat_sum)}")
-        lines.append(f"{hist}_count {total}")
+            le_pairs = (*m.labels, ("le", _fmt(le)))
+            add(
+                "request_latency_ms",
+                "histogram",
+                f"{hist}_bucket{_label_str(le_pairs)} {running}",
+            )
+        inf_pairs = (*m.labels, ("le", "+Inf"))
+        add(
+            "request_latency_ms",
+            "histogram",
+            f"{hist}_bucket{_label_str(inf_pairs)} {raw['latency_total']}",
+        )
+        add(
+            "request_latency_ms",
+            "histogram",
+            f"{hist}_sum{lbl} {_fmt(raw['latency_sum_ms'])}",
+        )
+        add(
+            "request_latency_ms",
+            "histogram",
+            f"{hist}_count{lbl} {raw['latency_total']}",
+        )
         # Convenience gauges (non-canonical but handy without a scraper).
-        for q, name in ((0.5, "p50"), (0.99, "p99")):
-            v = self.latency_quantile_ms(q)
+        for q, qname in ((0.5, "p50"), (0.99, "p99")):
+            v = m.latency_quantile_ms(q)
             if v is not None:
-                lines.append(f"# TYPE {_PREFIX}request_latency_{name}_ms gauge")
-                lines.append(f"{_PREFIX}request_latency_{name}_ms {_fmt(v)}")
-        return "\n".join(lines) + "\n"
+                add(
+                    f"request_latency_{qname}_ms",
+                    "gauge",
+                    f"{_PREFIX}request_latency_{qname}_ms{lbl} {_fmt(v)}",
+                )
+    lines = []
+    for name, s in series.items():
+        lines.append(f"# TYPE {_PREFIX}{name} {s['kind']}")
+        lines.extend(s["lines"])
+    return "\n".join(lines) + "\n"
+
+
+class MetricsHub:
+    """Registry of per-model ``ServeMetrics`` instances for one process.
+
+    ``get("")`` is the unlabelled fleet-level instance (routing counters,
+    paging gauges); ``get(model_id)`` returns the SAME labelled instance for
+    every caller asking about that model, so counters survive weight paging
+    (an evicted model's series keeps accumulating when it pages back in)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._instances: dict[str, ServeMetrics] = {}
+
+    def get(self, model: str = "") -> ServeMetrics:
+        with self._lock:
+            inst = self._instances.get(model)
+            if inst is None:
+                labels = (("model", model),) if model else ()
+                inst = ServeMetrics(labels=labels)
+                self._instances[model] = inst
+            return inst
+
+    def instances(self) -> list[ServeMetrics]:
+        with self._lock:
+            return list(self._instances.values())
+
+    def counter(self, name: str, model: str = "") -> float:
+        return self.get(model).counter(name)
+
+    def render_prometheus(self) -> str:
+        return render_prometheus_all(self.instances())
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            items = list(self._instances.items())
+        return {key or "_fleet": inst.snapshot() for key, inst in items}
 
 
 def _fmt(v: float) -> str:

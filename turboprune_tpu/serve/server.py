@@ -1,16 +1,27 @@
-"""Stdlib HTTP front-end for the inference engine.
+"""Stdlib HTTP front-end for the inference engine (single-model or fleet).
 
 Endpoints:
-  POST /predict   {"instances": [[H][W][C] floats, ...]} (one image or a
-                  [n, H, W, C] nested list) -> {"logits": ..., "classes": ...}
-  GET  /healthz   engine/checkpoint info + queue depth (200 = ready)
-  GET  /metrics   Prometheus text exposition (serve/metrics.py)
+  POST /predict   {"instances": [[H][W][C] floats, ...], "model": "level_3"}
+                  (one image or a [n, H, W, C] nested list; "model" is
+                  optional and only meaningful on a fleet server — it
+                  routes to a registry id, default = configured route)
+                  -> {"logits": ..., "classes": ..., "model": ...}
+  GET  /healthz   engine/checkpoint info + queue depth (200 = ready);
+                  fleet servers report one row per registered model
+  GET  /metrics   Prometheus text exposition (serve/metrics.py); fleet
+                  servers render every per-model series through the hub
 
 ThreadingHTTPServer gives one thread per connection; all of them funnel
-into the shared DynamicBatcher, which is where concurrency turns into
+into the shared DynamicBatcher(s), which is where concurrency turns into
 batched device steps. Backpressure surfaces as HTTP 503 (bounded queue
 full) so load sheds at the edge instead of growing an unbounded backlog.
-No extra dependencies — stdlib http.server + json only.
+Unknown model ids are HTTP 404 with the list of known ids. No extra
+dependencies — stdlib http.server + json only.
+
+Graceful shutdown: ``graceful_shutdown()`` stops accepting connections,
+then DRAINS the batcher(s) — every accepted request is answered within the
+configured deadline — before the socket closes. run_server.py wires this
+to SIGTERM, so a rolling restart finishes its in-flight work.
 """
 
 from __future__ import annotations
@@ -25,6 +36,7 @@ import numpy as np
 
 from .batcher import DynamicBatcher, QueueFullError
 from .engine import InferenceEngine
+from .fleet.registry import UnknownModelError
 from .metrics import ServeMetrics
 
 
@@ -74,37 +86,25 @@ class _Handler(BaseHTTPRequestHandler):
             length = int(self.headers.get("Content-Length") or 0)
             body = json.loads(self.rfile.read(length) or b"{}")
             instances = body["instances"]
+            model = str(body.get("model", "") or "")
         except (ValueError, KeyError) as e:
             self._send_json(
                 400, {"error": f"expected JSON body with 'instances': {e!r}"}
             )
             return
-        engine = self.server.engine
         try:
             arr = np.asarray(instances, dtype=np.float32)
         except (ValueError, TypeError) as e:
             self._send_json(400, {"error": f"non-numeric instances: {e!r}"})
             return
-        if arr.ndim == len(engine.input_shape):
-            arr = arr[None]
-        if (
-            arr.ndim != len(engine.input_shape) + 1
-            or arr.shape[1:] != engine.input_shape
-            or arr.shape[0] == 0
-        ):
-            self._send_json(
-                400,
-                {
-                    "error": (
-                        f"instances must be [n, "
-                        f"{', '.join(map(str, engine.input_shape))}] with "
-                        f"n >= 1, got shape {list(arr.shape)}"
-                    )
-                },
-            )
-            return
         try:
-            future = self.server.batcher.submit(arr)
+            future, meta = self.server.route(arr, model)
+        except UnknownModelError as e:
+            self._send_json(404, {"error": str(e)})
+            return
+        except ValueError as e:  # wrong shape / empty batch
+            self._send_json(400, {"error": str(e)})
+            return
         except QueueFullError as e:
             self._send_json(
                 503, {"error": str(e)}, headers={"Retry-After": "1"}
@@ -128,47 +128,83 @@ class _Handler(BaseHTTPRequestHandler):
             {
                 "logits": logits.tolist(),
                 "classes": np.argmax(logits, axis=-1).tolist(),
-                "model_level": engine.level,
-                "density": round(float(engine.density), 6),
+                **meta,
             },
         )
 
 
 class InferenceServer(ThreadingHTTPServer):
-    """HTTP server owning the engine + batcher + metrics triple."""
+    """HTTP server owning either one engine+batcher or a FleetEngine."""
 
     daemon_threads = True
 
     def __init__(
         self,
-        engine: InferenceEngine,
+        engine: Optional[InferenceEngine] = None,
         *,
+        fleet=None,
         host: str = "127.0.0.1",
         port: int = 8000,
         max_batch: int = 128,
         max_wait_ms: float = 5.0,
         queue_depth: int = 256,
         request_timeout_s: float = 30.0,
+        drain_timeout_s: float = 10.0,
         metrics: Optional[ServeMetrics] = None,
     ):
+        if (engine is None) == (fleet is None):
+            raise ValueError("pass exactly one of engine= or fleet=")
         self.engine = engine
-        self.metrics = metrics or engine.metrics or ServeMetrics()
+        self.fleet = fleet
         self.request_timeout_s = float(request_timeout_s)
-        self.batcher = DynamicBatcher(
-            engine,
-            max_batch=max_batch,
-            max_wait_ms=max_wait_ms,
-            queue_depth=queue_depth,
-            metrics=self.metrics,
-        ).start()
+        self.drain_timeout_s = float(drain_timeout_s)
+        if fleet is not None:
+            # Per-model batchers live inside the fleet; the hub renders
+            # every per-model series as one exposition.
+            self.metrics = fleet.hub
+            self.batcher = None
+        else:
+            self.metrics = metrics or engine.metrics or ServeMetrics()
+            self.batcher = DynamicBatcher(
+                engine,
+                max_batch=max_batch,
+                max_wait_ms=max_wait_ms,
+                queue_depth=queue_depth,
+                metrics=self.metrics,
+            ).start()
         self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        self._close_lock = threading.Lock()
         super().__init__((host, port), _Handler)
 
     @property
     def port(self) -> int:
         return self.server_address[1]
 
+    def route(self, arr: np.ndarray, model: str = ""):
+        """Submit one request; returns (future, response-metadata)."""
+        if self.fleet is not None:
+            future, resident = self.fleet.submit(arr, model=model)
+            eng = resident.engine
+            return future, {
+                "model": resident.spec.model_id,
+                "model_level": eng.level,
+                "backend": eng.backend,
+                "density": round(float(eng.density), 6),
+            }
+        if model:
+            raise UnknownModelError(
+                f"this server hosts a single model (level "
+                f"{self.engine.level}); 'model' routing needs serve.fleet"
+            )
+        return self.batcher.submit(arr), {
+            "model_level": self.engine.level,
+            "density": round(float(self.engine.density), 6),
+        }
+
     def health(self) -> dict:
+        if self.fleet is not None:
+            return {"status": "ok", **self.fleet.info()}
         return {
             "status": "ok",
             "queue_depth": self.batcher.queue_depth,
@@ -184,23 +220,53 @@ class InferenceServer(ThreadingHTTPServer):
             self._thread.start()
         return self
 
+    def graceful_shutdown(self, drain_timeout_s: Optional[float] = None):
+        """Stop accepting, answer in-flight within the deadline, close.
+        Safe to call from any thread EXCEPT the one running serve_forever
+        (shutdown() handshakes with it) — run_server.py's signal handler
+        spawns a thread for exactly that reason. Returns the drain report."""
+        timeout = (
+            self.drain_timeout_s
+            if drain_timeout_s is None
+            else float(drain_timeout_s)
+        )
+        self.shutdown()  # stop serve_forever wherever it is running
+        if self.fleet is not None:
+            report = self.fleet.drain(deadline_s=timeout)
+        else:
+            report = self.batcher.drain(deadline_s=timeout)
+        self._server_close_once()
+        return report
+
+    def _server_close_once(self) -> None:
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
+        self.server_close()
+
     def close(self) -> None:
         # shutdown() blocks on serve_forever's exit handshake — only safe
         # when OUR background thread is running it. A foreground
         # serve_forever (run_server.py) has already exited by the time
         # close() runs; a never-started server must skip it entirely.
-        if self._thread is not None:
+        if self._thread is not None and self._thread.is_alive():
             self.shutdown()
             self._thread.join(5.0)
-        self.batcher.close()
-        self.server_close()
+        if self.fleet is not None:
+            self.fleet.close()
+        else:
+            self.batcher.close()
+        self._server_close_once()
 
 
 def build_server(
     cfg, expt_dir: str = "", metrics: Optional[ServeMetrics] = None
 ) -> InferenceServer:
     """Compose an InferenceServer from a MainConfig with the serve group
-    (conf/serve.yaml: ``defaults: [serve: default]``)."""
+    (conf/serve.yaml: ``defaults: [serve: default]``). A populated
+    ``serve.fleet`` builds the multi-model fleet server; otherwise the
+    single-checkpoint server, exactly as before."""
     from ..config.schema import ConfigError
 
     sc = cfg.serve
@@ -208,6 +274,39 @@ def build_server(
         raise ConfigError(
             "config has no serve group — compose with conf/serve.yaml or "
             "add '+serve=default'"
+        )
+    if sc.fleet is not None:
+        from .fleet import FleetEngine, ModelRegistry, open_cache
+
+        fc = sc.fleet
+        dirs = [str(d) for d in fc.expt_dirs] or (
+            [expt_dir or sc.expt_dir] if (expt_dir or sc.expt_dir) else []
+        )
+        if not dirs:
+            raise ConfigError(
+                "fleet serving needs experiment dirs: set "
+                "serve.fleet.expt_dirs (or serve.expt_dir / --expt-dir)"
+            )
+        fleet = FleetEngine(
+            ModelRegistry(dirs),
+            buckets=tuple(sc.batch_buckets),
+            max_resident_models=fc.max_resident_models,
+            replicas=fc.replicas,
+            aot_cache=open_cache(fc.aot_cache_dir),
+            max_batch=sc.max_batch,
+            max_wait_ms=sc.max_wait_ms,
+            queue_depth=sc.queue_depth,
+            default_route=fc.default_route,
+            pinned_model=fc.pinned_model,
+            backend=fc.backend,
+            warmup=sc.warmup,
+        )
+        return InferenceServer(
+            fleet=fleet,
+            host=sc.host,
+            port=sc.port,
+            request_timeout_s=sc.request_timeout_s,
+            drain_timeout_s=sc.drain_timeout_s,
         )
     target = expt_dir or sc.expt_dir
     if not target:
@@ -233,5 +332,6 @@ def build_server(
         max_wait_ms=sc.max_wait_ms,
         queue_depth=sc.queue_depth,
         request_timeout_s=sc.request_timeout_s,
+        drain_timeout_s=sc.drain_timeout_s,
         metrics=metrics,
     )
