@@ -1,63 +1,83 @@
 #!/usr/bin/env bash
-# Pre-PR gate, three stages:
-#   1. graftlint --changed      — per-file rules on just the .py files
-#      changed vs main (fast half; stays O(diff) as the repo grows)
+# Pre-PR gate, seven stages:
+#   1. graftlint --changed      — per-file rules on just the .py/.yaml
+#      files changed vs the merge-base with main (fast half; stays
+#      O(diff) as the repo grows)
 #   2. graftlint --project      — whole-project mode: per-file rules over
 #      everything PLUS the interprocedural call-chain analysis PLUS the
 #      conf/ <-> schema cross-checks. This is the real gate; it is the
 #      same invocation tests/test_analysis.py's self-gate pins at zero
 #      unwaived findings and zero stale waivers.
-#   3. compact-train smoke      — the end-to-end harness lifecycle on
+#   3. jaxpr dtype audit        — trace the synthetic-task train step
+#      under the default fp32 policy and diff the jaxpr's
+#      convert_element_type ops against the static dtype findings and
+#      waivers. Must be clean: a reduced->wide upcast appearing here
+#      before any bf16 work lands is a dtype-flow regression.
+#   4. compact-train smoke      — the end-to-end harness lifecycle on
 #      synthetic .tpk data: 3 IMP levels, asserts the second level
 #      re-instantiates physically smaller, round-trips exactly back to
 #      full coordinates, eval parity holds across the exit expansion,
 #      and the per-width caches evict. Isolated stage so a compaction
 #      regression is named before the full suite runs.
-#   4. nm smoke                 — the N:M gathered-execution lifecycle on
+#   5. nm smoke                 — the N:M gathered-execution lifecycle on
 #      the same synthetic data: level 0 dense, nm criterion projects at
 #      prune time, the projected level runs gathered and exits back to
 #      the dense step functions with one cached executable, stale plans
 #      evict, and compact_train composes. Isolated so an N:M regression
 #      is named before the full suite runs.
-#   5. serving-load smoke       — the fleet serving drain + open-loop
+#   6. serving-load smoke       — the fleet serving drain + open-loop
 #      load generator on a jax-free fake engine: graceful drain answers
 #      in-flight work then sheds, and the Poisson sweep finds the
 #      saturation knee at the overloaded point, not the healthy one.
 #      Isolated (and jax-light, so it's fast) because loadgen bugs
 #      otherwise surface as flaky latency numbers in BENCH, not as a
 #      named failure.
-#   6. tier-1 fast tests        — the same command ROADMAP.md pins,
+#   7. tier-1 fast tests        — the same command ROADMAP.md pins,
 #      including its plugin surface (-p no:xdist -p no:randomly), so the
 #      gate and tier-1 agree on what "the suite" is.
-# Exits nonzero if any stage fails. Run from anywhere: paths resolve
-# relative to the repo root.
+# Each stage prints its wall time (even when it fails, so slow-AND-broken
+# is visible as both). Exits nonzero if any stage fails. Run from
+# anywhere: paths resolve relative to the repo root.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== graftlint --changed (per-file, vs main) =="
-python -m turboprune_tpu.analysis --changed
+run_stage() {
+    local name="$1"
+    shift
+    echo "== ${name} =="
+    local t0=${SECONDS} rc=0
+    "$@" || rc=$?
+    echo "-- ${name}: $(( SECONDS - t0 ))s (rc=${rc})"
+    return "${rc}"
+}
 
-echo "== graftlint --project (interprocedural + config rules) =="
-python -m turboprune_tpu.analysis --project turboprune_tpu conf tests
+run_stage "graftlint --changed (per-file, vs merge-base with main)" \
+    python -m turboprune_tpu.analysis --changed
 
-echo "== compact-train smoke (harness lifecycle on synthetic .tpk) =="
-JAX_PLATFORMS=cpu python -m pytest \
+run_stage "graftlint --project (interprocedural + config rules)" \
+    python -m turboprune_tpu.analysis --project turboprune_tpu conf tests
+
+run_stage "jaxpr dtype audit (train step, fp32 policy)" \
+    env JAX_PLATFORMS=cpu python -m turboprune_tpu.analysis --jaxpr-audit train
+
+run_stage "compact-train smoke (harness lifecycle on synthetic .tpk)" \
+    env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_compact_train.py::TestHarnessCompactTrainSmoke -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
-echo "== nm smoke (gathered N:M lifecycle on synthetic .tpk) =="
-JAX_PLATFORMS=cpu python -m pytest \
+run_stage "nm smoke (gathered N:M lifecycle on synthetic .tpk)" \
+    env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_nm.py::TestHarnessNMSmoke -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
-echo "== serving-load smoke (drain + open-loop knee, fake engine) =="
-JAX_PLATFORMS=cpu python -m pytest \
+run_stage "serving-load smoke (drain + open-loop knee, fake engine)" \
+    env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_fleet.py::TestGracefulDrain \
     tests/test_fleet.py::TestLoadgen -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
-echo "== tier-1 tests (fast tier, CPU) =="
-JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+run_stage "tier-1 tests (fast tier, CPU)" \
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider \
     -p no:xdist -p no:randomly
 

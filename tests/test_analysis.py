@@ -32,13 +32,14 @@ import pytest
 from turboprune_tpu.analysis import (
     CONF_RULES,
     RULES,
+    analyze_files,
     analyze_paths,
     analyze_project,
     analyze_source,
     render_json,
     render_text,
 )
-from turboprune_tpu.analysis.cli import main as cli_main
+from turboprune_tpu.analysis.cli import build_parser, main as cli_main
 
 REPO = Path(__file__).resolve().parents[1]
 
@@ -237,12 +238,110 @@ FIXTURES = {
             return normalized, factory
         """,
     ),
+    # ---- PR 12: dtype-flow rules ------------------------------------
+    "silent-upcast": (
+        """
+        import jax
+        import jax.numpy as jnp
+
+        # graftlint: dtype-policy=bf16
+        @jax.jit
+        def step(x):
+            scale = jnp.float32(2.0)
+            return jnp.mean(x * scale)
+        """,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        # graftlint: dtype-policy=bf16
+        @jax.jit
+        def step(x):
+            # weak python literal promotes DOWN to bf16 — fine; and the
+            # accumulation dtype is explicit — fine.
+            return jnp.mean(x * 2.0, dtype=jnp.float32)
+        """,
+    ),
+    "weak-type-promotion": (
+        """
+        import jax
+
+        @jax.jit
+        def scale_by(x, scale):
+            return x * scale
+
+        def warmup(x):
+            return scale_by(x, 2)
+
+        def train(x):
+            return scale_by(x, 2.0)
+        """,
+        """
+        import jax
+
+        @jax.jit
+        def scale_by(x, scale):
+            return x * scale
+
+        def warmup(x):
+            return scale_by(x, 2.0)
+
+        def train(x):
+            return scale_by(x, 3.0)
+        """,
+    ),
+    "scan-carry-dtype-drift": (
+        """
+        import jax.numpy as jnp
+        from jax import lax
+
+        def body(carry, x):
+            new = (carry + x).astype(jnp.bfloat16)
+            return new, x
+
+        def run_chunk(xs):
+            init = jnp.zeros((4,), jnp.float32)
+            return lax.scan(body, init, xs)
+        """,
+        """
+        import jax.numpy as jnp
+        from jax import lax
+
+        def body(carry, x):
+            new = (carry + x).astype(jnp.float32)
+            return new, x
+
+        def run_chunk(xs):
+            init = jnp.zeros((4,), jnp.float32)
+            return lax.scan(body, init, xs)
+        """,
+    ),
+    "missing-preferred-element-type": (
+        """
+        import jax
+        import jax.numpy as jnp
+
+        # graftlint: dtype-policy=bf16
+        @jax.jit
+        def project(a, b):
+            return jnp.matmul(a, b)
+        """,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        # graftlint: dtype-policy=bf16
+        @jax.jit
+        def project(a, b):
+            return jnp.matmul(a, b, preferred_element_type=jnp.float32)
+        """,
+    ),
 }
 
 
 class TestRuleFixtures:
     def test_rule_count_meets_floor(self):
-        assert len(RULES) >= 8
+        assert len(RULES) >= 13
         assert set(FIXTURES) <= set(RULES)
 
     @pytest.mark.parametrize("rule_id", sorted(FIXTURES))
@@ -1365,3 +1464,546 @@ class TestConfRules:
             cli_mod, "_changed_python_files", lambda base: []
         )
         assert cli_mod.main(["--changed"]) == 0
+
+
+# =================================================================
+# PR 12: dtype-flow analysis, SARIF, merge-base --changed, jaxpr audit
+# =================================================================
+
+
+class TestDtypeFlowEdgeCases:
+    """Lattice/policy semantics the bad/good FIXTURES pairs don't pin."""
+
+    def test_policy_comment_below_decorator_also_applies(self):
+        src = """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        # graftlint: dtype-policy=bf16
+        def step(x):
+            return jnp.mean(x)
+        """
+        assert "silent-upcast" in rules_hit(src)
+
+    def test_fp32_policy_opts_out_of_lexical_markers(self):
+        """A declared full-precision policy beats the bf16-names-in-body
+        heuristic — the triage escape hatch for fp32 code that merely
+        MENTIONS bfloat16."""
+        src = """
+        import jax
+        import jax.numpy as jnp
+
+        # graftlint: dtype-policy=fp32
+        @jax.jit
+        def step(x):
+            h = x.astype(jnp.bfloat16)
+            return jnp.mean(h)
+        """
+        assert "silent-upcast" not in rules_hit(src)
+
+    def test_lexical_bf16_marker_triggers_without_policy(self):
+        src = """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            h = x.astype(jnp.bfloat16)
+            return np.tanh(h)
+        """
+        hits = [f for f in run(src) if f.rule == "silent-upcast"]
+        assert hits and "np.tanh" in hits[0].message
+
+    def test_per_def_policies_are_independent(self):
+        src = """
+        import jax
+        import jax.numpy as jnp
+
+        # graftlint: dtype-policy=bf16
+        @jax.jit
+        def reduced(x):
+            return jnp.mean(x)
+
+        @jax.jit
+        def full(x):
+            return jnp.mean(x)
+        """
+        hits = [f for f in run(src) if f.rule == "silent-upcast"]
+        assert len(hits) == 1
+
+    def test_np_dtype_constructor_is_explicit_not_host_compute(self):
+        """np.float32(...) states a dtype; only the MIX with a reduced
+        operand fires, as arithmetic, not as np-host-compute."""
+        src = """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        # graftlint: dtype-policy=bf16
+        @jax.jit
+        def step(x):
+            scale = np.float32(0.5)
+            return x * scale
+        """
+        hits = [f for f in run(src) if f.rule == "silent-upcast"]
+        assert len(hits) == 1
+        assert "arithmetic mixes" in hits[0].message
+
+    def test_unknown_dtypes_stay_silent(self):
+        src = """
+        import jax
+        import jax.numpy as jnp
+
+        # graftlint: dtype-policy=bf16
+        @jax.jit
+        def step(x, helper):
+            return x * helper(x)
+        """
+        assert "silent-upcast" not in rules_hit(src)
+
+    def test_scan_drift_via_functools_partial(self):
+        src = """
+        import functools
+        import jax.numpy as jnp
+        from jax import lax
+
+        def body(model, carry, x):
+            return (carry + x).astype(jnp.bfloat16), x
+
+        def run_chunk(model, xs):
+            init = jnp.zeros((4,), jnp.float32)
+            return lax.scan(functools.partial(body, model), init, xs)
+        """
+        assert "scan-carry-dtype-drift" in rules_hit(src)
+
+    def test_scan_drift_via_lambda(self):
+        src = """
+        import jax.numpy as jnp
+        from jax import lax
+
+        def run_chunk(xs):
+            init = jnp.zeros((4,), jnp.float32)
+            return lax.scan(
+                lambda c, x: ((c + x).astype(jnp.bfloat16), x), init, xs
+            )
+        """
+        assert "scan-carry-dtype-drift" in rules_hit(src)
+
+    def test_scan_weak_carry_out_adopts_init_dtype(self):
+        src = """
+        import jax.numpy as jnp
+        from jax import lax
+
+        def body(carry, x):
+            return carry * 2.0, x
+
+        def run_chunk(xs):
+            init = jnp.zeros((4,), jnp.bfloat16)
+            return lax.scan(body, init, xs)
+        """
+        assert "scan-carry-dtype-drift" not in rules_hit(src)
+
+    def test_pet_einsum_skips_spec_string(self):
+        src = """
+        import jax
+        import jax.numpy as jnp
+
+        # graftlint: dtype-policy=bf16
+        @jax.jit
+        def project(a, b):
+            return jnp.einsum("ij,jk->ik", a, b)
+        """
+        assert "missing-preferred-element-type" in rules_hit(src)
+
+    def test_pet_silent_on_full_precision_operands(self):
+        src = """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def project(a, b):
+            return jnp.matmul(a, b)
+        """
+        assert "missing-preferred-element-type" not in rules_hit(src)
+
+    def test_dtype_rules_skip_test_files(self):
+        bad, _ = FIXTURES["silent-upcast"]
+        findings, _ = analyze_source(
+            textwrap.dedent(bad), "tests/test_mixed.py"
+        )
+        assert not [f for f in findings if f.rule == "silent-upcast"]
+
+
+class TestDtypeInterproc:
+    """The dtype seeding must cross module boundaries with a call path."""
+
+    FILES = {
+        "pkg/__init__.py": "",
+        "pkg/helpers.py": """
+            import jax.numpy as jnp
+
+            def fancy_norm(h):
+                return jnp.mean(h)
+
+            def project(a, b):
+                return jnp.matmul(a, b)
+            """,
+        "pkg/step.py": """
+            import jax
+
+            from .helpers import fancy_norm, project
+
+
+            # graftlint: dtype-policy=bf16
+            @jax.jit
+            def train_step(x, w):
+                h = project(x, w)
+                return fancy_norm(h)
+            """,
+    }
+
+    def test_helper_findings_fire_across_modules_with_trace(self, tmp_path):
+        r = run_project(tmp_path, self.FILES)
+        upcasts = unwaived(r, "silent-upcast")
+        pets = unwaived(r, "missing-preferred-element-type")
+        assert upcasts and "helpers.py" in upcasts[0].file
+        assert pets and "helpers.py" in pets[0].file
+        for f in upcasts + pets:
+            assert f.trace and "reduced jit entry" in f.trace[0]
+            assert "train_step" in f.trace[0]
+
+    def test_full_precision_entry_does_not_seed_helpers(self, tmp_path):
+        files = dict(self.FILES)
+        files["pkg/step.py"] = files["pkg/step.py"].replace(
+            "# graftlint: dtype-policy=bf16", ""
+        )
+        r = run_project(tmp_path, files)
+        assert not unwaived(r, "silent-upcast")
+        assert not unwaived(r, "missing-preferred-element-type")
+
+
+class TestScanRegionClassification:
+    """Satellite: lax.scan bodies passed as functools.partial or resolved
+    from an enclosing scope classify as traced regions — with the bound
+    leading params static and the carry traced."""
+
+    def test_partial_bound_scan_body_carry_is_traced(self):
+        src = """
+        import functools
+        import jax
+        import numpy as np
+
+        def body(model, carry, x):
+            return carry, np.asarray(x)
+
+        def epoch(model, state, batches):
+            return jax.lax.scan(
+                functools.partial(body, model), state, batches
+            )
+        """
+        assert "jit-host-sync" in rules_hit(src)
+
+    def test_partial_bound_scan_body_bound_param_is_static(self):
+        """float() of the partial-BOUND leading param is a Python value
+        at trace time; float() of the carry is a sync. Probes
+        traced_params directly."""
+        src = """
+        import functools
+        import jax
+
+        def body(cfg, carry, x):
+            scale = float(cfg)
+            return carry * scale, x
+
+        def epoch(cfg, state, batches):
+            return jax.lax.scan(
+                functools.partial(body, cfg), state, batches
+            )
+        """
+        assert "jit-host-sync" not in rules_hit(src)
+
+    def test_partial_bound_scan_body_carry_float_is_sync(self):
+        src = """
+        import functools
+        import jax
+
+        def body(cfg, carry, x):
+            scale = float(carry)
+            return carry * scale, x
+
+        def epoch(cfg, state, batches):
+            return jax.lax.scan(
+                functools.partial(body, cfg), state, batches
+            )
+        """
+        assert "jit-host-sync" in rules_hit(src)
+
+    def test_closure_scan_body_is_traced(self):
+        src = """
+        import jax
+        import numpy as np
+
+        def epoch(model, state, batches):
+            def body(carry, batch):
+                out = model(batch)
+                return carry + out, np.asarray(out)
+
+            return jax.lax.scan(body, state, batches)
+        """
+        assert "jit-host-sync" in rules_hit(src)
+
+    def test_closure_scan_body_without_sync_is_silent(self):
+        src = """
+        import jax
+
+        def epoch(model, state, batches):
+            def body(carry, batch):
+                out = model(batch)
+                return carry + out, out
+
+            return jax.lax.scan(body, state, batches)
+        """
+        assert "jit-host-sync" not in rules_hit(src)
+
+
+class TestWaiverScoping:
+    """Satellite: stale-waiver accounting per scope. Conf-only waivers are
+    project-scope (the per-file pass can never fire them); waivers naming
+    ANY per-file rule stay in per-file stale accounting."""
+
+    def test_py_rule_stale_waiver_flagged_per_file(self, tmp_path):
+        p = tmp_path / "m.py"
+        p.write_text("X = 1  # graftlint: disable=broad-except -- obsolete\n")
+        result = analyze_paths([p])
+        assert result.unused_waivers
+
+    def test_mixed_py_and_conf_waiver_still_stale_per_file(self, tmp_path):
+        p = tmp_path / "m.py"
+        p.write_text(
+            "X = 1  "
+            "# graftlint: disable=broad-except,conf-unknown-key -- obsolete\n"
+        )
+        result = analyze_paths([p])
+        assert result.unused_waivers
+
+    def test_conf_only_py_waiver_stale_in_project_mode(self, tmp_path):
+        r = run_project(
+            tmp_path,
+            {
+                "pkg/__init__.py": "",
+                "pkg/m.py": (
+                    "X = 1  "
+                    "# graftlint: disable=conf-dead-schema-field -- gone\n"
+                ),
+            },
+        )
+        assert r.unused_waivers
+
+    def test_changed_mode_uses_per_file_scoping(self, tmp_path):
+        """analyze_files (the --changed path) must not false-flag a
+        project-scope waiver either."""
+        p = tmp_path / "m.py"
+        p.write_text(
+            "X = 1  # graftlint: disable=conf-dead-schema-field -- scope\n"
+        )
+        result = analyze_files([p])
+        assert not result.unused_waivers
+
+
+class TestChangedMergeBase:
+    """Satellite: --changed diffs against the merge-base, not the tip of
+    the base branch, and picks up untracked .py/.yaml files."""
+
+    @staticmethod
+    def _git(cwd, *args):
+        import subprocess
+
+        subprocess.run(
+            ["git", "-c", "user.name=t", "-c", "user.email=t@t"]
+            + list(args),
+            cwd=cwd,
+            check=True,
+            capture_output=True,
+        )
+
+    def test_merge_base_and_untracked(self, tmp_path, monkeypatch):
+        import turboprune_tpu.analysis.cli as cli_mod
+
+        repo = tmp_path / "r"
+        repo.mkdir()
+        g = lambda *a: self._git(repo, *a)  # noqa: E731
+        g("init", "-q")
+        (repo / "a.py").write_text("A = 1\n")
+        g("add", "a.py")
+        g("commit", "-qm", "init")
+        g("branch", "-M", "main")
+        g("checkout", "-qb", "feature")
+        (repo / "b.py").write_text("B = 2\n")
+        g("add", "b.py")
+        g("commit", "-qm", "feature work")
+        # advance main past the branch point: its diff vs the feature
+        # worktree must NOT leak into --changed
+        g("checkout", "-q", "main")
+        (repo / "a.py").write_text("A = 99\n")
+        g("commit", "-aqm", "main moved on")
+        g("checkout", "-q", "feature")
+        (repo / "c.yaml").write_text("k: v\n")  # untracked, lintable
+        (repo / "c.txt").write_text("notes\n")  # untracked, not lintable
+
+        monkeypatch.chdir(repo)
+        files = cli_mod._changed_python_files("main")
+        assert "b.py" in files
+        assert "c.yaml" in files
+        assert "a.py" not in files
+        assert "c.txt" not in files
+
+    def test_changed_routes_yaml_through_conf_rules(self, tmp_path):
+        y = tmp_path / "train.yaml"
+        y.write_text("lr: 0.1\nlr: 0.2\n")
+        result = analyze_files([y])
+        assert [f for f in result.unwaived if f.rule == "conf-duplicate-key"]
+        assert result.files_analyzed == 1
+
+
+class TestSarifReporter:
+    def _result(self, tmp_path):
+        p = tmp_path / "bad.py"
+        p.write_text(
+            textwrap.dedent(
+                """
+                import jax
+
+                @jax.jit
+                def step(x):
+                    return x.item()
+
+                @jax.jit
+                def step2(x):
+                    # graftlint: disable=jit-host-sync -- pinned fixture
+                    return x.item()
+                """
+            )
+        )
+        return p
+
+    def test_sarif_shape_and_suppressions(self, tmp_path, capsys):
+        p = self._result(tmp_path)
+        rc = cli_main([str(p), "--format", "sarif"])
+        assert rc == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["version"] == "2.1.0"
+        runrec = doc["runs"][0]
+        assert runrec["tool"]["driver"]["name"] == "graftlint"
+        rules = {r["id"] for r in runrec["tool"]["driver"]["rules"]}
+        assert "jit-host-sync" in rules
+        results = runrec["results"]
+        assert len(results) == 2
+        suppressed = [r for r in results if "suppressions" in r]
+        live = [r for r in results if "suppressions" not in r]
+        assert len(suppressed) == 1 and len(live) == 1
+        assert suppressed[0]["suppressions"][0]["kind"] == "inSource"
+        assert "pinned fixture" in (
+            suppressed[0]["suppressions"][0]["justification"]
+        )
+        loc = live[0]["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith("bad.py")
+        assert loc["region"]["startLine"] >= 1
+
+    def test_format_json_matches_json_flag(self, tmp_path, capsys):
+        p = self._result(tmp_path)
+        cli_main([str(p), "--format", "json"])
+        via_format = capsys.readouterr().out
+        cli_main([str(p), "--json"])
+        via_flag = capsys.readouterr().out
+        assert json.loads(via_format) == json.loads(via_flag)
+
+    def test_help_documents_exit_codes_and_modes(self):
+        text = build_parser().format_help()
+        assert "exit codes" in text
+        for marker in ("--jaxpr-audit", "--format", "merge-base"):
+            assert marker in text
+
+
+class TestJaxprAudit:
+    """--jaxpr-audit on tiny synthetic entries (the full train-step audit
+    runs in scripts/check.sh; here we pin the diff semantics)."""
+
+    PLANTED = textwrap.dedent(
+        """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+
+        @jax.jit
+        def step(x):
+            h = x.astype(jnp.bfloat16)
+            y = h * np.float32(2.0)
+            return y.sum()
+
+
+        def entry():
+            return step, (jnp.ones((4, 4), jnp.float32),)
+        """
+    )
+
+    def test_planted_upcast_caught_statically_and_in_jaxpr(
+        self, tmp_path, capsys
+    ):
+        pytest.importorskip("jax")
+        # statically: the bf16*f32 mix is a silent-upcast finding
+        findings, _ = analyze_source(self.PLANTED, "lib/planted.py")
+        assert [f for f in findings if f.rule == "silent-upcast"]
+        # dynamically: the same line shows up as a reduced->wide convert
+        p = tmp_path / "planted.py"
+        p.write_text(self.PLANTED)
+        rc = cli_main(["--jaxpr-audit", f"{p}:entry"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "bfloat16 -> float32" in out
+        assert "[finding]" in out
+        assert "NOT clean" in out
+
+    def test_explicit_cast_audits_clean(self, tmp_path, capsys):
+        pytest.importorskip("jax")
+        src = textwrap.dedent(
+            """
+            import jax
+            import jax.numpy as jnp
+
+
+            # graftlint: dtype-policy=bf16
+            @jax.jit
+            def step(x):
+                h = x.astype(jnp.bfloat16)
+                y = h.astype(jnp.float32)
+                return y.sum()
+
+
+            def entry():
+                return step, (jnp.ones((4, 4), jnp.float32),)
+            """
+        )
+        p = tmp_path / "clean.py"
+        p.write_text(src)
+        rc = cli_main(["--jaxpr-audit", f"{p}:entry"])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "[explicit-cast]" in out
+        assert "jaxpr-audit: clean" in out
+
+    def test_bad_entry_spec_is_usage_error(self, capsys):
+        pytest.importorskip("jax")
+        assert cli_main(["--jaxpr-audit", "nonsense"]) == 2
+        assert "entry" in capsys.readouterr().err
+
+    def test_missing_entry_file_is_usage_error(self, capsys):
+        pytest.importorskip("jax")
+        assert cli_main(["--jaxpr-audit", "/nonexistent/x.py:entry"]) == 2
+        capsys.readouterr()
+
+    def test_audit_mutually_exclusive_with_project(self, capsys):
+        assert cli_main(["--project", "--jaxpr-audit"]) == 2
+        capsys.readouterr()

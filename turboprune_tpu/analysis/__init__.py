@@ -34,6 +34,7 @@ from .core import (  # noqa: F401
     RULES,
     Rule,
     Waiver,
+    analyze_files,
     analyze_paths,
     analyze_project,
     analyze_source,
@@ -41,8 +42,9 @@ from .core import (  # noqa: F401
     register,
 )
 from . import rules  # noqa: F401  (registers the rule set)
+from . import dtype_rules  # noqa: F401  (registers the dtype-flow rules)
 from .conf_rules import CONF_RULES  # noqa: F401
-from .reporters import render_json, render_text  # noqa: F401
+from .reporters import render_json, render_sarif, render_text  # noqa: F401
 
 __all__ = [
     "AnalysisResult",
@@ -52,11 +54,13 @@ __all__ = [
     "RULES",
     "Rule",
     "Waiver",
+    "analyze_files",
     "analyze_paths",
     "analyze_project",
     "analyze_source",
     "is_test_file",
     "register",
     "render_json",
+    "render_sarif",
     "render_text",
 ]

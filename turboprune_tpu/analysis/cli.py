@@ -2,22 +2,28 @@
 
 Exit codes (the contract scripts/check.sh and CI build on):
   0 — analyzed clean: zero unwaived findings
-  1 — at least one unwaived finding
+  1 — at least one unwaived finding (or a failed --jaxpr-audit diff)
   2 — usage / environment error (bad path, unknown rule in --select,
-      git unavailable for --changed)
+      git unavailable for --changed, jax unavailable for --jaxpr-audit)
 
-Three modes:
+Four modes:
 
-* per-file (default) — the eight lexical rules over the given paths;
+* per-file (default) — the lexical rules over the given paths;
 * ``--project`` — per-file PLUS the interprocedural layer (symbol
   table + call graph, rules fire through call chains with call-path
   traces) PLUS the config rules over every ``*.yaml`` under the paths.
   This is the pre-PR gate: ``--project turboprune_tpu conf tests``;
-* ``--changed [BASE]`` — per-file rules over only the ``.py`` files
-  changed vs BASE (default ``main``, via ``git diff --name-only`` plus
-  untracked files), so the fast half of the gate stays fast as the repo
-  grows. Project mode intentionally has no --changed variant: call
-  graphs and config cross-checks are whole-repo properties.
+* ``--changed [BASE]`` — per-file rules over only the ``.py``/``.yaml``
+  files changed vs ``git merge-base HEAD BASE`` (default ``main``), plus
+  untracked files, so the fast half of the gate stays fast as the repo
+  grows and doesn't drag in files that only changed ON main. Project
+  mode intentionally has no --changed variant: call graphs and config
+  cross-checks are whole-repo properties;
+* ``--jaxpr-audit [ENTRY]`` — trace the real train/eval step (or a
+  ``file.py:builder`` entry) under ``--dtype-policy`` and diff the
+  jaxpr's convert_element_type ops against the static dtype findings
+  and waivers (jaxpr_audit.py). Needs jax importable; everything else
+  here runs with no accelerator stack.
 
 With no paths it analyzes the installed ``turboprune_tpu`` package — the
 same invocation the self-gate test makes, so "the linter passes" means the
@@ -33,8 +39,17 @@ from pathlib import Path
 from typing import Optional, Sequence
 
 from .conf_rules import CONF_RULES
-from .core import RULES, analyze_paths, analyze_project
-from .reporters import render_json, render_text
+from .core import RULES, analyze_files, analyze_paths, analyze_project
+from .reporters import render_json, render_sarif, render_text
+
+_EPILOG = """\
+exit codes:
+  0  analyzed clean: zero unwaived findings (jaxpr audit: clean diff)
+  1  at least one unwaived finding (jaxpr audit: unexplained upcast or
+     unwaived static dtype finding)
+  2  usage or environment error (bad path, unknown rule in --select,
+     git unavailable for --changed, jax unavailable for --jaxpr-audit)
+"""
 
 
 def _default_paths() -> list:
@@ -56,10 +71,14 @@ def build_parser() -> argparse.ArgumentParser:
         description=(
             "graftlint: JAX-aware static analysis (host syncs in jit, "
             "retrace hazards, PRNG key reuse, rank-conditional "
-            "collectives, donated-buffer reads, swallowed exceptions; "
-            "--project adds interprocedural call-chain analysis and "
-            "conf/ schema cross-checking)"
+            "collectives, donated-buffer reads, swallowed exceptions, "
+            "dtype-flow upcast/promotion hazards; --project adds "
+            "interprocedural call-chain analysis and conf/ schema "
+            "cross-checking; --jaxpr-audit grounds the dtype rules in "
+            "the traced jaxpr)"
         ),
+        epilog=_EPILOG,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
     )
     p.add_argument(
         "paths",
@@ -70,7 +89,7 @@ def build_parser() -> argparse.ArgumentParser:
         "--project",
         action="store_true",
         help=(
-            "whole-project mode: interprocedural jit/RNG/collective "
+            "whole-project mode: interprocedural jit/RNG/collective/dtype "
             "analysis over the call graph plus conf/*.yaml schema "
             "cross-checks, on top of the per-file rules"
         ),
@@ -81,12 +100,42 @@ def build_parser() -> argparse.ArgumentParser:
         const="main",
         metavar="BASE",
         help=(
-            "lint only .py files changed vs BASE (default: main) per "
-            "git diff --name-only, plus untracked files"
+            "lint only .py/.yaml files changed vs the merge-base of HEAD "
+            "and BASE (default: main), plus untracked files"
         ),
     )
     p.add_argument(
-        "--json", action="store_true", help="machine-readable JSON report"
+        "--jaxpr-audit",
+        nargs="?",
+        const="train",
+        metavar="ENTRY",
+        help=(
+            "trace ENTRY ('train', 'eval', 'file.py:builder' or "
+            "'pkg.module:builder' returning (fn, args)) under "
+            "--dtype-policy and diff jaxpr convert_element_type ops "
+            "against static dtype findings and waivers (needs jax)"
+        ),
+    )
+    p.add_argument(
+        "--dtype-policy",
+        choices=("fp32", "bf16"),
+        default="fp32",
+        help=(
+            "dtype policy for --jaxpr-audit's default entries: fp32 "
+            "(default; must audit clean) or bf16 (casts step inputs to "
+            "bfloat16 — the mixed-precision acceptance harness)"
+        ),
+    )
+    p.add_argument(
+        "--format",
+        choices=("text", "json", "sarif"),
+        default=None,
+        help="report format (default: text; sarif renders CI annotations)",
+    )
+    p.add_argument(
+        "--json",
+        action="store_true",
+        help="machine-readable JSON report (alias for --format json)",
     )
     p.add_argument(
         "--show-waived",
@@ -107,10 +156,24 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def _changed_python_files(base: str) -> list:
-    """Changed-vs-base plus untracked .py files, as git reports them."""
+    """Lintable files changed vs the merge-base of HEAD and ``base``
+    (NOT the base tip: diffing against an advanced main would drag in
+    every file main changed and miss nothing-but-noise), plus untracked
+    files. Py and yaml both count — per-file rules for the former, the
+    schema-independent conf checks for the latter."""
+    merge = subprocess.run(
+        ["git", "merge-base", "HEAD", base],
+        capture_output=True,
+        text=True,
+    )
+    diff_base = (
+        merge.stdout.strip()
+        if merge.returncode == 0 and merge.stdout.strip()
+        else base
+    )
     files: list = []
     for cmd in (
-        ["git", "diff", "--name-only", base, "--"],
+        ["git", "diff", "--name-only", diff_base, "--"],
         ["git", "ls-files", "--others", "--exclude-standard"],
     ):
         proc = subprocess.run(
@@ -120,7 +183,11 @@ def _changed_python_files(base: str) -> list:
     out = []
     seen = set()
     for f in files:
-        if f.endswith(".py") and f not in seen and Path(f).exists():
+        if (
+            f.endswith((".py", ".yaml", ".yml"))
+            and f not in seen
+            and Path(f).exists()
+        ):
             seen.add(f)
             out.append(f)
     return out
@@ -141,13 +208,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             )
         return 0
 
-    if args.project and args.changed:
+    modes = [
+        name
+        for name, on in (
+            ("--project", args.project),
+            ("--changed", bool(args.changed)),
+            ("--jaxpr-audit", bool(args.jaxpr_audit)),
+        )
+        if on
+    ]
+    if len(modes) > 1:
         print(
-            "--project and --changed are mutually exclusive (the project "
-            "layer is a whole-repo property)",
+            f"{' and '.join(modes)} are mutually exclusive",
             file=sys.stderr,
         )
         return 2
+
+    fmt = args.format or ("json" if args.json else "text")
 
     select = None
     if args.select:
@@ -159,6 +236,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 f"(known: {', '.join(sorted(all_rules))})",
                 file=sys.stderr,
             )
+            return 2
+
+    if args.jaxpr_audit:
+        from .jaxpr_audit import AuditError, run_audit
+
+        try:
+            return run_audit(
+                entry=args.jaxpr_audit, policy=args.dtype_policy
+            )
+        except AuditError as e:
+            print(f"graftlint --jaxpr-audit: {e}", file=sys.stderr)
             return 2
 
     try:
@@ -180,10 +268,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 return 2
             if not files:
                 print(
-                    f"graftlint: no .py files changed vs {args.changed}"
+                    f"graftlint: no lintable files changed vs {args.changed}"
                 )
                 return 0
-            result = analyze_paths(files, select=select)
+            result = analyze_files(files, select=select)
         elif args.project:
             result = analyze_project(
                 args.paths or _default_project_paths(), select=select
@@ -196,8 +284,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(f"graftlint: {e}", file=sys.stderr)
         return 2
 
-    if args.json:
+    if fmt == "json":
         print(render_json(result))
+    elif fmt == "sarif":
+        print(render_sarif(result))
     else:
         print(render_text(result, show_waived=args.show_waived))
     return 1 if result.unwaived else 0

@@ -47,6 +47,7 @@ __all__ = [
     "ModuleContext",
     "AnalysisResult",
     "analyze_source",
+    "analyze_files",
     "analyze_paths",
     "analyze_project",
     "is_test_file",
@@ -241,17 +242,25 @@ class AnalysisResult:
 
     @property
     def unused_waivers(self) -> list:
-        """Waivers that matched nothing. In per-file mode, waivers naming
-        only project-scope rules (the ``conf-*`` set) are out of scope —
-        they CANNOT match there and only project mode may call them stale
-        (which the project self-gate does)."""
+        """Waivers that matched nothing. In per-file mode (including
+        ``--changed``), waivers naming only project-scope rules are out of
+        scope — they CANNOT match there and only project mode may call
+        them stale (which the project self-gate does). The project-only
+        set is derived from the conf-rule registry (plus the ``conf-``
+        prefix as a guard for rules not yet registered), so a new conf
+        rule never reintroduces the false-stale bug by omission."""
         unused = [w for w in self.waivers if not w.used]
         if self.project:
             return unused
+        from .conf_rules import CONF_RULES  # lazy: conf_rules imports core
+
+        project_only = set(CONF_RULES)
         return [
             w
             for w in unused
-            if not all(r.startswith("conf-") for r in w.rules)
+            if not all(
+                r in project_only or r.startswith("conf-") for r in w.rules
+            )
         ]
 
 
@@ -361,6 +370,63 @@ def analyze_paths(
         findings=all_findings,
         waivers=all_waivers,
         files_analyzed=len(files),
+    )
+
+
+def _conf_root_for(path: Path) -> Path:
+    """Best-effort conf root for a yaml analyzed WITHOUT project context:
+    the tree up to (and including) the last ``conf`` path component, so
+    group-shaped paths still resolve; else the file's directory."""
+    parts = path.parts
+    if "conf" in parts[:-1]:
+        idx = max(i for i, c in enumerate(parts[:-1]) if c == "conf")
+        return Path(*parts[: idx + 1])
+    return path.parent
+
+
+def analyze_files(
+    files: Iterable,
+    select: Optional[Sequence[str]] = None,
+) -> AnalysisResult:
+    """Per-file mode over an explicit mixed list of ``.py`` and
+    ``.yaml``/``.yml`` files (the ``--changed`` surface): Python files get
+    the per-file rules; yaml files get the schema-independent conf checks
+    (parse errors, duplicate keys, defaults shape — no project symbol
+    table, so the schema cross-checks stay project mode's job)."""
+    from .conf_rules import analyze_conf
+
+    py_files: list = []
+    yaml_files: list = []
+    for f in files:
+        p = Path(f)
+        if p.suffix == ".py":
+            py_files.append(p)
+        elif p.suffix in (".yaml", ".yml"):
+            yaml_files.append((p, _conf_root_for(p)))
+        else:
+            raise FileNotFoundError(f"not a .py/.yaml file: {p}")
+    all_findings: list = []
+    all_waivers: list = []
+    for f in py_files:
+        findings, waivers = analyze_source(
+            f.read_text(encoding="utf-8"), f, select=select
+        )
+        all_findings.extend(findings)
+        all_waivers.extend(waivers)
+    if yaml_files:
+        conf_findings, conf_waivers = analyze_conf(yaml_files, {})
+        conf_findings = [
+            f for f in conf_findings if not select or f.rule in select
+        ]
+        all_findings.extend(
+            _apply_waivers_by_file(conf_findings, conf_waivers)
+        )
+        all_waivers.extend(conf_waivers)
+    all_findings.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
+    return AnalysisResult(
+        findings=all_findings,
+        waivers=all_waivers,
+        files_analyzed=len(py_files) + len(yaml_files),
     )
 
 

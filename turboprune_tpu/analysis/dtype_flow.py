@@ -1,0 +1,675 @@
+"""Dtype lattice + abstract interpretation for the dtype-flow rules.
+
+JAX's promotion table makes reduced precision easy to lose silently: a
+strongly-typed ``np.float32`` scalar, a default-dtype ``jnp.mean``, or one
+``jnp.zeros`` without ``dtype=`` quietly promotes a bf16 path back to
+f32 — no error, no speedup, and the jaxpr is the only witness. This module
+gives the rules in dtype_rules.py a static approximation of that table:
+
+* a small dtype lattice — ``f64 / f32 / bf16 / f16 / int / weak-float /
+  weak-int / unknown`` — with :func:`join` modelling JAX's binary-op
+  promotion (weak scalars promote DOWN into strong dtypes; two strong
+  floats promote UP to the wider one; ``unknown`` absorbs);
+* :class:`ScopeDtypes`, a single-pass abstract interpreter over a function
+  body that assigns every expression node a lattice value (assignments
+  flow, branches join, loop bodies run twice for loop-carried names);
+* dtype-policy comments — ``# graftlint: dtype-policy=bf16`` — parsed like
+  waivers (tokenizer, so ``#`` in strings is ignored). A policy comment
+  applies to the next function definition below it; with no def following
+  it declares the whole module. Policies both OPT IN (``bf16`` seeds the
+  region's traced params reduced so the upcast rules fire) and OPT OUT
+  (``fp32`` on a region with incidental bf16 markers silences them).
+
+Everything here is stdlib ``ast``/``tokenize`` — same no-jax-at-import
+contract as the rest of the package. The promotion model is deliberately
+an approximation: ``unknown`` is the honest default, and rules only fire
+when BOTH sides of a hazard infer to known lattice values, so precision
+errs toward silence, never toward false findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import re
+import tokenize
+from typing import Iterable, Optional
+
+from .regions import dotted_name
+
+__all__ = [
+    "UNKNOWN",
+    "REDUCED",
+    "STRONG_FLOATS",
+    "join",
+    "binop_result",
+    "dtype_from_expr",
+    "ScopeDtypes",
+    "DtypePolicies",
+    "parse_dtype_policies",
+    "reduced_hint",
+    "region_reduced",
+]
+
+# ------------------------------------------------------------------ lattice
+
+F64, F32, BF16, F16 = "f64", "f32", "bf16", "f16"
+INT = "int"
+WEAK_FLOAT, WEAK_INT = "weak-float", "weak-int"
+UNKNOWN = "unknown"
+
+REDUCED = frozenset({BF16, F16})
+STRONG_FLOATS = frozenset({F64, F32, BF16, F16})
+WEAK = frozenset({WEAK_FLOAT, WEAK_INT})
+
+_FLOAT_RANK = {BF16: 1, F16: 1, F32: 2, F64: 3}
+
+
+def join(a: str, b: str) -> str:
+    """Result dtype of a binary op between ``a`` and ``b`` under JAX's
+    promotion rules (the interesting property: weak scalars promote DOWN —
+    ``bf16 + 1.0`` stays bf16 — while strong operands promote UP —
+    ``bf16 + np.float32(1)`` is f32)."""
+    if a == b:
+        return a
+    if a == UNKNOWN or b == UNKNOWN:
+        return UNKNOWN
+    if a in WEAK and b in WEAK:
+        return WEAK_FLOAT if WEAK_FLOAT in (a, b) else WEAK_INT
+    # one weak, one strong: weak-int never promotes; weak-float promotes an
+    # INT operand to the default float type and leaves floats alone.
+    for weak, strong in ((a, b), (b, a)):
+        if weak in WEAK:
+            if weak == WEAK_FLOAT and strong == INT:
+                return F32
+            return strong
+    # both strong
+    if a == INT:
+        return b
+    if b == INT:
+        return a
+    if _FLOAT_RANK[a] == _FLOAT_RANK[b]:
+        return F32  # bf16 + f16 -> f32 in JAX's table
+    return a if _FLOAT_RANK[a] > _FLOAT_RANK[b] else b
+
+
+def binop_result(op: ast.AST, a: str, b: str) -> str:
+    """``join`` plus true-division's int -> float coercion."""
+    out = join(a, b)
+    if isinstance(op, ast.Div) and out in (INT, WEAK_INT):
+        return WEAK_FLOAT if out == WEAK_INT else F32
+    return out
+
+
+# ------------------------------------------------- dtype-name recognition
+
+_DTYPE_TAILS = {
+    "bfloat16": BF16,
+    "float16": F16,
+    "half": F16,
+    "float32": F32,
+    "single": F32,
+    "float64": F64,
+    "double": F64,
+    "float_": F64,
+    "int8": INT,
+    "int16": INT,
+    "int32": INT,
+    "int64": INT,
+    "uint8": INT,
+    "uint16": INT,
+    "uint32": INT,
+    "uint64": INT,
+    "int_": INT,
+    "bool_": INT,
+}
+_DTYPE_ROOTS = {"jnp", "np", "numpy", "onp", "jax", "ml_dtypes"}
+
+
+def _dtype_from_name(name: Optional[str]) -> Optional[str]:
+    if not name:
+        return None
+    parts = name.split(".")
+    if parts[-1] not in _DTYPE_TAILS:
+        return None
+    if len(parts) > 1 and parts[0] not in _DTYPE_ROOTS:
+        return None
+    return _DTYPE_TAILS[parts[-1]]
+
+
+def dtype_from_expr(node: Optional[ast.AST]) -> Optional[str]:
+    """``jnp.bfloat16`` / ``np.float32`` / ``"bfloat16"`` -> lattice value;
+    None for anything unrecognized (a variable holding a dtype, etc.)."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return _DTYPE_TAILS.get(node.value)
+    return _dtype_from_name(dotted_name(node))
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+# ------------------------------------------------- the abstract interpreter
+
+def _tail(name: Optional[str]) -> Optional[str]:
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+def _root(name: Optional[str]) -> Optional[str]:
+    return name.split(".", 1)[0] if name else None
+
+
+def _is_jnp(name: Optional[str]) -> bool:
+    if not name:
+        return False
+    return (
+        _root(name) in ("jnp", "nn")
+        or name.startswith("jax.numpy.")
+        or name.startswith("jax.nn.")
+        or name.startswith("jax.scipy.")
+    )
+
+
+def _is_np(name: Optional[str]) -> bool:
+    return _root(name) in ("np", "numpy", "onp")
+
+
+def _is_lax(name: Optional[str]) -> bool:
+    return bool(name) and "lax" in name.split(".")
+
+
+_CREATION = {"zeros", "ones", "empty", "full", "eye", "identity", "arange", "linspace"}
+_LIKE = {"zeros_like", "ones_like", "empty_like", "full_like"}
+_CONVERT = {"array", "asarray"}
+_REDUCTIONS = {
+    "sum", "mean", "prod", "var", "std", "amax", "amin", "max", "min",
+    "nansum", "nanmean", "cumsum", "cumprod", "average", "norm", "logsumexp",
+}
+_MATMULS = {"matmul", "dot", "tensordot", "inner", "outer", "vdot", "einsum"}
+_INT_RESULTS = {"argmax", "argmin", "argsort", "searchsorted", "digitize"}
+_PAIR_ELEMENTWISE = {
+    "add", "subtract", "multiply", "divide", "true_divide", "power",
+    "maximum", "minimum", "mod", "remainder", "atan2", "hypot",
+}
+_PASSTHROUGH = {
+    "exp", "log", "log2", "log10", "log1p", "expm1", "sqrt", "rsqrt",
+    "tanh", "sin", "cos", "tan", "sinh", "cosh", "erf", "abs", "negative",
+    "square", "sign", "relu", "relu6", "gelu", "silu", "swish", "sigmoid",
+    "softplus", "softmax", "log_softmax", "logsumexp", "reshape",
+    "transpose", "broadcast_to", "squeeze", "expand_dims", "ravel", "roll",
+    "flip", "pad", "tile", "repeat", "sort", "clip", "take",
+    "take_along_axis", "moveaxis", "swapaxes", "real", "stop_gradient",
+    "cumsum", "cumprod", "tril", "triu", "diag", "nan_to_num",
+}
+_JOIN_LIST = {"concatenate", "stack", "hstack", "vstack", "block"}
+_SELF_METHODS_PASS = {
+    "reshape", "transpose", "copy", "flatten", "ravel", "squeeze", "clip",
+    "take", "sort", "round", "conj", "block_until_ready",
+}
+_SELF_METHODS_REDUCE = {"sum", "mean", "prod", "max", "min", "var", "std", "cumsum"}
+_RANDOM_SAMPLERS = {
+    "normal", "uniform", "truncated_normal", "gamma", "beta", "exponential",
+    "laplace", "cauchy", "dirichlet", "ball", "gumbel", "logistic",
+}
+
+
+class ScopeDtypes:
+    """One forward pass over a function (or module) body: every expression
+    node gets a lattice value in ``self.at`` (keyed by ``id(node)``), and
+    top-level ``return`` statements collect in ``self.returns``.
+
+    Nested function definitions are interpreted with a copy of the current
+    environment (closures see outer bindings) and their parameters seeded
+    unknown — their expression dtypes land in ``self.at`` too, but their
+    assignments don't leak out and their returns aren't the scope's.
+    """
+
+    def __init__(self, scope: Optional[ast.AST], seed: Optional[dict] = None):
+        self.at: dict = {}
+        self.returns: list = []  # (Return node, dtype-of-value)
+        env = dict(seed or {})
+        if scope is None:
+            return
+        if isinstance(scope, ast.Module):
+            self._exec_block(scope.body, env, top=True)
+        elif isinstance(scope, ast.Lambda):
+            d = self._infer(scope.body, env)
+            self.returns.append((scope.body, d))
+        else:  # FunctionDef / AsyncFunctionDef
+            for p in self._params(scope):
+                env.setdefault(p, UNKNOWN)
+            self._exec_block(scope.body, env, top=True)
+
+    # ---------------------------------------------------------------- query
+
+    def dtype_of(self, node: ast.AST) -> str:
+        return self.at.get(id(node), UNKNOWN)
+
+    # -------------------------------------------------------------- helpers
+
+    @staticmethod
+    def _params(fn: ast.AST) -> list:
+        a = fn.args
+        return [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+
+    def _assign_target(self, target: ast.AST, dtype: str, env: dict) -> None:
+        if isinstance(target, ast.Name):
+            env[target.id] = dtype
+        elif isinstance(target, ast.Starred):
+            self._assign_target(target.value, UNKNOWN, env)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._assign_target(elt, UNKNOWN, env)
+        # attribute/subscript targets: no tracked binding
+
+    def _assign(self, target: ast.AST, value: ast.AST, env: dict) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)) and isinstance(
+            value, (ast.Tuple, ast.List)
+        ) and len(target.elts) == len(value.elts):
+            for t, v in zip(target.elts, value.elts):
+                self._assign(t, v, env)
+            return
+        self._assign_target(target, self._infer(value, env), env)
+
+    # ----------------------------------------------------------- statements
+
+    def _exec_block(self, stmts: Iterable, env: dict, top: bool) -> None:
+        for stmt in stmts:
+            self._exec(stmt, env, top)
+
+    def _exec(self, stmt: ast.AST, env: dict, top: bool) -> None:
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                self._assign(t, stmt.value, env)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._assign(stmt.target, stmt.value, env)
+        elif isinstance(stmt, ast.AugAssign):
+            v = self._infer(stmt.value, env)
+            if isinstance(stmt.target, ast.Name):
+                cur = env.get(stmt.target.id, UNKNOWN)
+                env[stmt.target.id] = binop_result(stmt.op, cur, v)
+        elif isinstance(stmt, ast.Return):
+            d = self._infer(stmt.value, env) if stmt.value is not None else UNKNOWN
+            if top:
+                self.returns.append((stmt, d))
+        elif isinstance(stmt, ast.Expr):
+            self._infer(stmt.value, env)
+        elif isinstance(stmt, ast.If):
+            self._infer(stmt.test, env)
+            a, b = dict(env), dict(env)
+            self._exec_block(stmt.body, a, top)
+            self._exec_block(stmt.orelse, b, top)
+            for k in set(a) | set(b):
+                env[k] = join(a.get(k, UNKNOWN), b.get(k, UNKNOWN))
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._infer(stmt.iter, env)
+            self._assign_target(stmt.target, UNKNOWN, env)
+            # two passes so loop-carried rebindings converge
+            self._exec_block(stmt.body, env, top)
+            self._exec_block(stmt.body, env, top)
+            self._exec_block(stmt.orelse, env, top)
+        elif isinstance(stmt, ast.While):
+            self._infer(stmt.test, env)
+            self._exec_block(stmt.body, env, top)
+            self._exec_block(stmt.body, env, top)
+            self._exec_block(stmt.orelse, env, top)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._infer(item.context_expr, env)
+            self._exec_block(stmt.body, env, top)
+        elif isinstance(stmt, ast.Try):
+            self._exec_block(stmt.body, env, top)
+            for h in stmt.handlers:
+                self._exec_block(h.body, env, top)
+            self._exec_block(stmt.orelse, env, top)
+            self._exec_block(stmt.finalbody, env, top)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            inner = dict(env)
+            for p in self._params(stmt):
+                inner[p] = UNKNOWN
+            self._exec_block(stmt.body, inner, top=False)
+        # ClassDef / imports / pass / etc: nothing to track
+
+    # ---------------------------------------------------------- expressions
+
+    def _infer(self, node: Optional[ast.AST], env: dict) -> str:
+        if node is None:
+            return UNKNOWN
+        d = self._infer_inner(node, env)
+        self.at[id(node)] = d
+        return d
+
+    def _infer_inner(self, node: ast.AST, env: dict) -> str:
+        if isinstance(node, ast.Constant):
+            v = node.value
+            if isinstance(v, bool):
+                return WEAK_INT
+            if isinstance(v, int):
+                return WEAK_INT
+            if isinstance(v, float):
+                return WEAK_FLOAT
+            return UNKNOWN
+        if isinstance(node, ast.Name):
+            return env.get(node.id, UNKNOWN)
+        if isinstance(node, ast.BinOp):
+            return binop_result(
+                node.op,
+                self._infer(node.left, env),
+                self._infer(node.right, env),
+            )
+        if isinstance(node, ast.UnaryOp):
+            return self._infer(node.operand, env)
+        if isinstance(node, ast.IfExp):
+            self._infer(node.test, env)
+            return join(
+                self._infer(node.body, env), self._infer(node.orelse, env)
+            )
+        if isinstance(node, ast.Compare):
+            self._infer(node.left, env)
+            for c in node.comparators:
+                self._infer(c, env)
+            return INT  # bool array; behaves as an integer type in promotion
+        if isinstance(node, ast.BoolOp):
+            for v in node.values:
+                self._infer(v, env)
+            return UNKNOWN
+        if isinstance(node, ast.Subscript):
+            self._infer(node.slice, env)
+            return self._infer(node.value, env)
+        if isinstance(node, ast.Attribute):
+            if node.attr in ("T", "mT", "real", "at"):
+                return self._infer(node.value, env)
+            if node.attr in ("ndim", "size"):
+                self._infer(node.value, env)
+                return WEAK_INT
+            self._infer(node.value, env)
+            return UNKNOWN
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            for elt in node.elts:
+                self._infer(elt, env)
+            return UNKNOWN
+        if isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                self._infer(k, env)
+                self._infer(v, env)
+            return UNKNOWN
+        if isinstance(node, ast.Call):
+            return self._infer_call(node, env)
+        if isinstance(node, ast.Lambda):
+            inner = dict(env)
+            for p in self._params(node):
+                inner[p] = UNKNOWN
+            self._infer(node.body, inner)
+            return UNKNOWN
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)):
+            return UNKNOWN
+        if isinstance(node, ast.Starred):
+            return self._infer(node.value, env)
+        return UNKNOWN
+
+    def _infer_call(self, node: ast.Call, env: dict) -> str:
+        for arg in node.args:
+            self._infer(arg, env)
+        for kw in node.keywords:
+            self._infer(kw.value, env)
+
+        f = node.func
+        # --- method calls ------------------------------------------------
+        if isinstance(f, ast.Attribute):
+            recv = self._infer(f.value, env)
+            if f.attr == "astype":
+                return dtype_from_expr(node.args[0] if node.args else _kw(node, "dtype")) or UNKNOWN
+            if f.attr in _SELF_METHODS_REDUCE:
+                d = dtype_from_expr(_kw(node, "dtype"))
+                return d if d else recv
+            if f.attr in _SELF_METHODS_PASS:
+                return recv
+            if f.attr in ("set", "add", "multiply", "divide", "min", "max", "power", "get", "apply"):
+                # .at[idx].set(v) family: result keeps the array's dtype
+                if _chain_has_at(f.value):
+                    return recv
+        name = dotted_name(f)
+        tail = _tail(name)
+
+        # --- dtype constructors: jnp.float32(x), np.bfloat16(x), ... ------
+        ctor = _dtype_from_name(name)
+        if ctor and isinstance(f, (ast.Name, ast.Attribute)):
+            return ctor
+        if name == "float":
+            return WEAK_FLOAT
+        if name in ("int", "len", "round", "ord"):
+            return WEAK_INT
+
+        if name is None or tail is None:
+            return UNKNOWN
+
+        explicit = dtype_from_expr(_kw(node, "dtype"))
+        pet = dtype_from_expr(_kw(node, "preferred_element_type"))
+
+        # --- jax.numpy / jax.nn -------------------------------------------
+        if _is_jnp(name) or _is_lax(name):
+            if tail == "astype" and len(node.args) >= 2:
+                return dtype_from_expr(node.args[1]) or UNKNOWN
+            if tail == "convert_element_type":
+                d = dtype_from_expr(_kw(node, "new_dtype")) or dtype_from_expr(
+                    node.args[1] if len(node.args) >= 2 else None
+                )
+                return d or UNKNOWN
+            if tail in ("dot_general", "conv_general_dilated", "conv"):
+                if pet:
+                    return pet
+                if len(node.args) >= 2:
+                    return join(
+                        self.dtype_of(node.args[0]), self.dtype_of(node.args[1])
+                    )
+                return UNKNOWN
+            if tail in _MATMULS:
+                if pet:
+                    return pet
+                operands = node.args
+                if tail == "einsum" and operands and isinstance(operands[0], ast.Constant):
+                    operands = operands[1:]
+                out = UNKNOWN
+                known = [
+                    self.dtype_of(a) for a in operands
+                    if self.dtype_of(a) != UNKNOWN
+                ]
+                if known and len(known) == len(list(operands)):
+                    out = known[0]
+                    for d in known[1:]:
+                        out = join(out, d)
+                return out
+            if tail in _CREATION:
+                if explicit:
+                    return explicit
+                if tail == "full" and len(node.args) >= 3:
+                    d = dtype_from_expr(node.args[2])
+                    if d:
+                        return d
+                if tail == "arange":
+                    if all(self.dtype_of(a) in (WEAK_INT, INT) for a in node.args):
+                        return INT
+                return F32
+            if tail in _CONVERT:
+                if explicit:
+                    return explicit
+                if len(node.args) >= 2:
+                    d = dtype_from_expr(node.args[1])
+                    if d:
+                        return d
+                return self.dtype_of(node.args[0]) if node.args else UNKNOWN
+            if tail in _LIKE:
+                if explicit:
+                    return explicit
+                return self.dtype_of(node.args[0]) if node.args else UNKNOWN
+            if tail in _REDUCTIONS:
+                if explicit:
+                    return explicit
+                return self.dtype_of(node.args[0]) if node.args else UNKNOWN
+            if tail in _INT_RESULTS:
+                return INT
+            if tail == "where" and len(node.args) >= 3:
+                return join(
+                    self.dtype_of(node.args[1]), self.dtype_of(node.args[2])
+                )
+            if tail in _PAIR_ELEMENTWISE and len(node.args) >= 2:
+                return join(
+                    self.dtype_of(node.args[0]), self.dtype_of(node.args[1])
+                )
+            if tail in _JOIN_LIST and node.args:
+                seq = node.args[0]
+                if isinstance(seq, (ast.Tuple, ast.List)) and seq.elts:
+                    out = self.dtype_of(seq.elts[0])
+                    for e in seq.elts[1:]:
+                        out = join(out, self.dtype_of(e))
+                    return out
+                return UNKNOWN
+            if tail in _PASSTHROUGH:
+                return self.dtype_of(node.args[0]) if node.args else UNKNOWN
+            return UNKNOWN
+
+        # --- numpy: strongly typed, float64 default ----------------------
+        if _is_np(name):
+            if explicit:
+                return explicit
+            if tail in _INT_RESULTS:
+                return INT
+            if tail in (_CONVERT | _CREATION | _LIKE | _REDUCTIONS | _PASSTHROUGH
+                        | _PAIR_ELEMENTWISE | _MATMULS):
+                arg_d = self.dtype_of(node.args[0]) if node.args else UNKNOWN
+                if arg_d in (WEAK_FLOAT,):
+                    return F64  # np hardens python floats to float64
+                if arg_d == WEAK_INT:
+                    return INT
+                return arg_d
+            return UNKNOWN
+
+        # --- jax.random samplers ------------------------------------------
+        if name.startswith("jax.random.") or _root(name) == "random":
+            if tail in _RANDOM_SAMPLERS:
+                return explicit or F32
+            if tail in ("randint", "categorical", "choice", "permutation", "bernoulli"):
+                return INT
+            return UNKNOWN
+
+        return UNKNOWN
+
+
+def _chain_has_at(node: ast.AST) -> bool:
+    while True:
+        if isinstance(node, ast.Attribute):
+            if node.attr == "at":
+                return True
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            node = node.value
+        else:
+            return False
+
+
+# ------------------------------------------------------------ dtype policy
+
+_POLICY_RE = re.compile(r"graftlint:\s*dtype-policy=([A-Za-z0-9_]+)")
+_POLICY_ALIASES = {
+    "bf16": BF16, "bfloat16": BF16,
+    "f16": F16, "fp16": F16, "float16": F16,
+    "f32": F32, "fp32": F32, "float32": F32,
+    "f64": F64, "fp64": F64, "float64": F64,
+}
+
+
+@dataclasses.dataclass
+class DtypePolicies:
+    """Parsed ``# graftlint: dtype-policy=...`` declarations for one file:
+    ``module`` (policy with no def following it) plus ``spans`` of
+    ``(start, end, policy)`` for policies attached to a def."""
+
+    module: Optional[str] = None
+    spans: list = dataclasses.field(default_factory=list)
+
+    def for_line(self, line: int) -> Optional[str]:
+        """Innermost declared policy governing ``line`` (module fallback)."""
+        best = None
+        for start, end, policy in self.spans:
+            if start <= line <= end and (best is None or start > best[0]):
+                best = (start, policy)
+        return best[1] if best else self.module
+
+
+def parse_dtype_policies(source: str, tree: ast.AST) -> DtypePolicies:
+    comments: list = []
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                m = _POLICY_RE.search(tok.string)
+                if m:
+                    policy = _POLICY_ALIASES.get(m.group(1).lower())
+                    if policy:
+                        comments.append((tok.start[0], policy))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return DtypePolicies()
+
+    defs = sorted(
+        (
+            n
+            for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ),
+        key=lambda n: n.lineno,
+    )
+    out = DtypePolicies()
+    for line, policy in comments:
+        target = next((d for d in defs if d.lineno > line), None)
+        if target is None:
+            out.module = policy
+        else:
+            out.spans.append(
+                (target.lineno, target.end_lineno or target.lineno, policy)
+            )
+    return out
+
+
+# ------------------------------------------------- reduced-context detection
+
+_REDUCED_NAME_TAILS = {"bfloat16", "float16", "half"}
+
+
+def reduced_hint(node: ast.AST) -> bool:
+    """True when the body lexically mentions a reduced dtype (an
+    ``astype(jnp.bfloat16)``, a ``dtype=jnp.bfloat16`` kwarg, a
+    ``"bfloat16"`` string) — the opt-in signal for files with no declared
+    policy."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in _REDUCED_NAME_TAILS:
+            return True
+        if isinstance(sub, ast.Name) and sub.id in _REDUCED_NAME_TAILS:
+            return True
+        if (
+            isinstance(sub, ast.Constant)
+            and isinstance(sub.value, str)
+            and sub.value in ("bfloat16", "float16")
+        ):
+            return True
+    return False
+
+
+def region_reduced(region, policies: DtypePolicies):
+    """``(dtype, why)`` when the region is a reduced-precision context —
+    via declared policy or lexical bf16 markers — else None. A declared
+    full-precision policy (fp32/fp64) beats lexical markers: it is the
+    opt-out for regions that merely mention reduced dtypes."""
+    policy = policies.for_line(region.start)
+    if policy is not None:
+        if policy in REDUCED:
+            return policy, f"dtype-policy={policy}"
+        return None
+    if reduced_hint(region.node):
+        return BF16, "bf16 markers in body"
+    return None

@@ -1,0 +1,558 @@
+"""Dtype-flow rules: the static half of ROADMAP item 6's bf16 guardrail.
+
+Four rules over the lattice/interpreter in dtype_flow.py, all tuned to the
+ways JAX silently re-promotes a reduced-precision path to f32:
+
+* ``silent-upcast`` — inside a reduced-precision jit region (declared
+  ``# graftlint: dtype-policy=bf16`` or lexically marked with bf16 casts),
+  arithmetic that mixes a reduced operand with a strongly-typed f32/f64
+  one, ``np.*`` compute on traced values (float64 on host), default-dtype
+  ``jnp.mean``/``var``/``std``/``softmax`` accumulation, and Python float
+  literals hardening integer operands to f32.
+* ``weak-type-promotion`` — the same traced parameter of a jitted callable
+  receiving a Python int literal at one call site and a float literal at
+  another: the weak scalar hardens to i32 vs f32 across the jit boundary,
+  which is a dtype flip and a silent recompile the retrace-hazard rule
+  (which only sees jit CONSTRUCTION) cannot catch.
+* ``scan-carry-dtype-drift`` — ``lax.scan`` call sites where the inferred
+  init dtype differs from the dtype the body returns for the carry slot.
+  XLA either raises at trace time or, for weakly-typed drifts, re-promotes
+  per iteration. Bodies resolve through ``functools.partial`` (bound
+  leading args skipped) and closures, matching regions.py.
+* ``missing-preferred-element-type`` — matmul/conv-family calls on reduced
+  operands without an explicit accumulation dtype; the in-repo idiom is
+  ``lax.dot_general(..., preferred_element_type=jnp.float32)``
+  (ops/flash.py).
+
+In project mode the first and last rules also fire through call chains: a
+helper reachable from a reduced jit entry is analyzed with its params
+seeded to the entry's reduced dtype, and findings carry the call-path
+trace, same shape as interproc.py's. All four rules skip test files —
+tests mix dtypes on purpose — and only fire when the lattice KNOWS both
+sides of a hazard, so ``unknown`` stays silent rather than noisy.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Iterator, Optional
+
+from .core import ModuleContext, Rule, register
+from .dtype_flow import (
+    BF16,
+    F32,
+    F64,
+    INT,
+    REDUCED,
+    UNKNOWN,
+    WEAK_FLOAT,
+    WEAK_INT,
+    DtypePolicies,
+    ScopeDtypes,
+    join,
+    parse_dtype_policies,
+    region_reduced,
+)
+from .regions import (
+    dotted_name,
+    is_jit_wrapper,
+    is_tracing_call,
+    param_names,
+    partial_bindings,
+)
+
+__all__ = [
+    "SilentUpcastRule",
+    "WeakTypePromotionRule",
+    "ScanCarryDtypeDriftRule",
+    "MissingPreferredElementTypeRule",
+    "dtype_project_findings",
+]
+
+
+def _tail(name: Optional[str]) -> Optional[str]:
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+def _root(name: Optional[str]) -> Optional[str]:
+    return name.split(".", 1)[0] if name else None
+
+
+def _policies(ctx: ModuleContext) -> DtypePolicies:
+    cached = getattr(ctx, "_dtype_policies", None)
+    if cached is None:
+        cached = parse_dtype_policies(ctx.source, ctx.tree)
+        ctx._dtype_policies = cached
+    return cached
+
+
+def _reduced_regions(ctx: ModuleContext) -> Iterator:
+    """(region, dtype, why, ScopeDtypes) for each reduced-precision jit
+    region — traced params seeded to the region's reduced dtype so flow
+    starts from the declared inputs."""
+    pol = _policies(ctx)
+    for region in ctx.jit_regions:
+        red = region_reduced(region, pol)
+        if red is None:
+            continue
+        dt, why = red
+        seed = {p: dt for p in region.traced_params}
+        yield region, dt, why, ScopeDtypes(region.node, seed=seed)
+
+
+# --------------------------------------------------------- silent-upcast
+
+_NP_ROOTS = {"np", "numpy", "onp"}
+# host-pull tails are jit-host-sync's finding already; don't double-report
+_NP_PULL_TAILS = {"array", "asarray", "asanyarray", "frombuffer", "copy"}
+# np dtype constructors are an EXPLICIT dtype choice, not a silent one
+_NP_CTOR_TAILS = {
+    "float16", "float32", "float64", "half", "single", "double",
+    "int8", "int16", "int32", "int64", "uint8", "uint32", "bool_",
+}
+_ACCUM_TAILS = {"mean", "var", "std", "softmax", "log_softmax"}
+_ARITH_OPS = (
+    ast.Add, ast.Sub, ast.Mult, ast.Div, ast.FloorDiv, ast.Mod,
+    ast.Pow, ast.MatMult,
+)
+
+
+def _is_jnp_like(name: Optional[str]) -> bool:
+    if not name:
+        return False
+    return (
+        _root(name) in ("jnp", "nn")
+        or name.startswith("jax.numpy.")
+        or name.startswith("jax.nn.")
+    )
+
+
+def _upcast_scan(
+    rule: Rule,
+    ctx: ModuleContext,
+    root: ast.AST,
+    sd: ScopeDtypes,
+    why: str,
+    trace_fn: Optional[Callable] = None,
+) -> Iterator:
+    for node in ast.walk(root):
+        if not isinstance(node, (ast.BinOp, ast.Call)):
+            continue
+        trace = trace_fn(node) if trace_fn else None
+        if isinstance(node, ast.BinOp) and isinstance(node.op, _ARITH_OPS):
+            l, r = sd.dtype_of(node.left), sd.dtype_of(node.right)
+            pair = {l, r}
+            if (pair & REDUCED) and (pair & {F32, F64}):
+                yield ctx.finding(
+                    rule,
+                    node,
+                    f"arithmetic mixes {l} and {r}: the reduced operand "
+                    f"silently promotes to {join(l, r)} and the bf16 "
+                    f"speedup is lost (reduced-precision context: {why}); "
+                    "cast one operand explicitly so the promotion is a "
+                    "decision, not an accident",
+                    trace=trace,
+                )
+            elif (
+                WEAK_FLOAT in pair
+                and INT in pair
+                and (
+                    isinstance(node.left, ast.Constant)
+                    or isinstance(node.right, ast.Constant)
+                )
+            ):
+                yield ctx.finding(
+                    rule,
+                    node,
+                    "Python float literal in arithmetic with an integer "
+                    "traced value hardens to f32 (reduced-precision "
+                    f"context: {why}); use jnp.asarray(literal, dtype) or "
+                    "an integer literal",
+                    trace=trace,
+                )
+        elif isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            tail = _tail(name)
+            if (
+                _root(name) in _NP_ROOTS
+                and tail not in _NP_PULL_TAILS
+                and tail not in _NP_CTOR_TAILS
+                and any(
+                    sd.dtype_of(a) in (REDUCED | {F32, INT})
+                    for a in node.args
+                )
+            ):
+                yield ctx.finding(
+                    rule,
+                    node,
+                    f"{name}(...) on a traced value computes on host in "
+                    "float64 — a silent upcast AND a device sync "
+                    f"(reduced-precision context: {why}); use the jnp "
+                    "equivalent with an explicit dtype",
+                    trace=trace,
+                )
+            elif (
+                _is_jnp_like(name)
+                and tail in _ACCUM_TAILS
+                and not any(
+                    kw.arg in ("dtype", "preferred_element_type")
+                    for kw in node.keywords
+                )
+                and node.args
+                and sd.dtype_of(node.args[0]) in REDUCED
+            ):
+                d = sd.dtype_of(node.args[0])
+                yield ctx.finding(
+                    rule,
+                    node,
+                    f"{name}(...) accumulates in {d} with no explicit "
+                    f"accumulation dtype (reduced-precision context: {why})"
+                    " — long reductions lose mass in bf16; pass "
+                    "dtype=jnp.float32 (or upcast the operand explicitly)",
+                    trace=trace,
+                )
+
+
+@register
+class SilentUpcastRule(Rule):
+    id = "silent-upcast"
+    severity = "warning"
+    skip_in_tests = True
+    description = (
+        "fp32-promoting op (strong-f32 operand mix, np.* on traced values, "
+        "default-dtype mean/var/softmax accumulation) inside a "
+        "reduced-precision jit region"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator:
+        for region, _dt, why, sd in _reduced_regions(ctx):
+            yield from _upcast_scan(self, ctx, region.node, sd, why)
+
+
+# -------------------------------------------------- weak-type-promotion
+
+
+def _weak_literal_class(arg: ast.AST) -> Optional[str]:
+    if isinstance(arg, ast.UnaryOp) and isinstance(
+        arg.op, (ast.USub, ast.UAdd)
+    ):
+        arg = arg.operand
+    if isinstance(arg, ast.Constant) and not isinstance(arg.value, bool):
+        if isinstance(arg.value, int):
+            return "int"
+        if isinstance(arg.value, float):
+            return "float"
+    return None
+
+
+def _static_names(call: ast.Call) -> set:
+    from .regions import literal_str_seq
+
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            return set(literal_str_seq(kw.value) or ())
+    return set()
+
+
+@register
+class WeakTypePromotionRule(Rule):
+    id = "weak-type-promotion"
+    severity = "warning"
+    skip_in_tests = True
+    description = (
+        "same traced param of a jitted callable gets a Python int literal "
+        "at one site and a float literal at another — the weak scalar "
+        "hardens to different dtypes across the jit boundary (silent "
+        "recompile per flip)"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator:
+        # jitted callables visible in this module, by the name calls use
+        jitted: dict = {}  # callable name -> (positional params, traced set)
+        defs = {
+            n.name: n
+            for n in ast.walk(ctx.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        for region in ctx.jit_regions:
+            node = region.node
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) and region.reason.startswith("@"):
+                jitted[node.name] = (param_names(node), region.traced_params)
+        for node in ast.walk(ctx.tree):
+            # g = jax.jit(f, ...): calls to g cross the boundary
+            if (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and is_jit_wrapper(node.value.func)
+                and node.value.args
+                and isinstance(node.value.args[0], ast.Name)
+                and node.value.args[0].id in defs
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                fn = defs[node.value.args[0].id]
+                static = _static_names(node.value)
+                params = param_names(fn)
+                jitted[node.targets[0].id] = (
+                    params,
+                    frozenset(p for p in params if p not in static),
+                )
+
+        if not jitted:
+            return
+        sites: dict = {}  # (callable, param) -> {class: first call node}
+        for node in ast.walk(ctx.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in jitted
+            ):
+                continue
+            params, traced = jitted[node.func.id]
+            bindings = list(zip(params, node.args)) + [
+                (kw.arg, kw.value) for kw in node.keywords if kw.arg
+            ]
+            for param, arg in bindings:
+                if param not in traced:
+                    continue
+                cls = _weak_literal_class(arg)
+                if cls is None:
+                    continue
+                sites.setdefault((node.func.id, param), {}).setdefault(
+                    cls, node
+                )
+        for (fname, param), by_class in sites.items():
+            if "int" in by_class and "float" in by_class:
+                first, second = sorted(
+                    (by_class["int"], by_class["float"]),
+                    key=lambda n: (n.lineno, n.col_offset),
+                )
+                yield ctx.finding(
+                    self,
+                    second,
+                    f"jitted {fname}() takes a Python int for traced param "
+                    f"{param!r} at line {first.lineno} and a float here — "
+                    "the weak scalar hardens to i32 vs f32 across the jit "
+                    "boundary, so each flip recompiles silently; pass "
+                    "jnp.asarray(v, dtype) or make the literals agree",
+                )
+
+
+# ------------------------------------------------ scan-carry-dtype-drift
+
+
+def _harden(d: str) -> str:
+    if d == WEAK_FLOAT:
+        return F32
+    if d == WEAK_INT:
+        return INT
+    return d
+
+
+@register
+class ScanCarryDtypeDriftRule(Rule):
+    id = "scan-carry-dtype-drift"
+    severity = "error"
+    skip_in_tests = True
+    description = (
+        "lax.scan carry-in dtype differs from the dtype the body returns "
+        "for the carry slot (trace error or per-iteration re-promotion)"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator:
+        defs = {
+            n.name: n
+            for n in ast.walk(ctx.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        sd = ScopeDtypes(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and is_tracing_call(node.func)
+                and _tail(dotted_name(node.func)) == "scan"
+                and len(node.args) >= 2
+            ):
+                continue
+            d_in = _harden(sd.dtype_of(node.args[1]))
+            if d_in == UNKNOWN:
+                continue
+            body, n_bound, _kw_bound = partial_bindings(node.args[0])
+            if isinstance(body, ast.Name):
+                body = defs.get(body.id)
+            if not isinstance(
+                body, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            positional = [
+                p.arg for p in body.args.posonlyargs + body.args.args
+            ]
+            if n_bound >= len(positional):
+                continue
+            carry = positional[n_bound]
+            body_sd = ScopeDtypes(body, seed={carry: d_in})
+            for ret, _d in body_sd.returns:
+                val = ret.value if isinstance(ret, ast.Return) else ret
+                if not (isinstance(val, ast.Tuple) and val.elts):
+                    continue
+                d_out = body_sd.dtype_of(val.elts[0])
+                if d_out in (UNKNOWN, WEAK_FLOAT, WEAK_INT):
+                    continue  # weak carries adopt the init dtype
+                if d_out != d_in:
+                    body_name = getattr(body, "name", "<lambda>")
+                    yield ctx.finding(
+                        self,
+                        node,
+                        f"lax.scan carry enters as {d_in} but body "
+                        f"{body_name}() returns {d_out} for the carry slot "
+                        "— carry-in and carry-out dtypes must match "
+                        "exactly; cast the returned carry back (or fix the "
+                        "init dtype)",
+                    )
+                    break
+
+
+# ------------------------------------- missing-preferred-element-type
+
+_MATMUL_TAILS = {"matmul", "dot", "tensordot", "einsum"}
+_LAX_MATMUL_TAILS = {"dot_general", "conv_general_dilated", "conv"}
+
+
+def _pet_scan(
+    rule: Rule,
+    ctx: ModuleContext,
+    root: ast.AST,
+    sd: ScopeDtypes,
+    why: str,
+    trace_fn: Optional[Callable] = None,
+) -> Iterator:
+    for node in ast.walk(root):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        tail = _tail(name)
+        if tail in _MATMUL_TAILS:
+            if not (_is_jnp_like(name) or _root(name) == "lax"):
+                continue
+        elif tail in _LAX_MATMUL_TAILS:
+            if not (name and "lax" in name.split(".")):
+                continue
+        else:
+            continue
+        if any(kw.arg == "preferred_element_type" for kw in node.keywords):
+            continue
+        operands = node.args
+        if tail == "einsum" and operands and isinstance(operands[0], ast.Constant):
+            operands = operands[1:]
+        hits = [sd.dtype_of(a) for a in operands if sd.dtype_of(a) in REDUCED]
+        if not hits:
+            continue
+        yield ctx.finding(
+            rule,
+            node,
+            f"{name}(...) on {hits[0]} operands without "
+            "preferred_element_type — the MXU accumulates in f32 but the "
+            f"result truncates back to {hits[0]} per tile "
+            f"(reduced-precision context: {why}); pass "
+            "preferred_element_type=jnp.float32 (pattern: ops/flash.py)",
+            trace=trace_fn(node) if trace_fn else None,
+        )
+
+
+@register
+class MissingPreferredElementTypeRule(Rule):
+    id = "missing-preferred-element-type"
+    severity = "warning"
+    skip_in_tests = True
+    description = (
+        "matmul/conv call on reduced-precision operands without an "
+        "explicit accumulation dtype (preferred_element_type)"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator:
+        for region, _dt, why, sd in _reduced_regions(ctx):
+            yield from _pet_scan(self, ctx, region.node, sd, why)
+
+
+# ------------------------------------------------------- project layer
+
+
+def dtype_project_findings(graph, contexts: dict) -> Iterator:
+    """silent-upcast / missing-preferred-element-type through call chains:
+    helpers reachable from a REDUCED jit entry are analyzed with params
+    seeded to the entry's reduced dtype (the entry passes its traced
+    values on), each finding carrying the call path that justifies the
+    seeding. Helpers that are themselves lexical regions are the per-file
+    pass's job and are skipped, mirroring interproc._host_sync_findings."""
+    from .callgraph import MAX_DEPTH, _fmt
+    from .core import RULES
+
+    upcast = RULES["silent-upcast"]
+    pet = RULES["missing-preferred-element-type"]
+
+    lexical_nodes = {
+        id(r.node)
+        for regions in graph.regions_by_module.values()
+        for r in regions
+    }
+    entries: list = []
+    for mi in graph.index.modules.values():
+        ctx = contexts.get(mi.path)
+        if ctx is None:
+            continue
+        pol = _policies(ctx)
+        for region in graph.regions_by_module.get(mi.modname, ()):
+            red = region_reduced(region, pol)
+            if red is None:
+                continue
+            fi = graph.index.function_for_node(region.node)
+            if fi is not None:
+                entries.append((fi, red))
+
+    reach: dict = {}  # qualname -> (dtype, why, trace hops)
+    frontier = []
+    for fi, (dt, why) in entries:
+        if fi.qualname not in reach:
+            reach[fi.qualname] = (
+                dt,
+                why,
+                [f"reduced jit entry {_fmt(fi)} [{why}]"],
+            )
+            frontier.append(fi)
+    depth = 0
+    while frontier and depth < MAX_DEPTH:
+        depth += 1
+        nxt = []
+        for fi in frontier:
+            dt, why, trace = reach[fi.qualname]
+            for callee, line in graph.edges.get(fi.qualname, ()):
+                if callee.qualname in reach:
+                    continue
+                reach[callee.qualname] = (
+                    dt,
+                    why,
+                    trace + [f"{_fmt(callee)} called at line {line}"],
+                )
+                nxt.append(callee)
+        frontier = nxt
+
+    entry_quals = {fi.qualname for fi, _ in entries}
+    for qual, (dt, why, trace) in reach.items():
+        if qual in entry_quals:
+            continue
+        fi = graph.index.functions.get(qual)
+        if fi is None or id(fi.node) in lexical_nodes:
+            continue
+        ctx = contexts.get(fi.path)
+        if ctx is None:
+            continue
+        sd = ScopeDtypes(fi.node, seed={p: dt for p in fi.params})
+        why_chain = f"{why}, via caller"
+
+        def trace_fn(node, _fi=fi, _trace=trace):
+            return _trace + [f"{_fi.name} ({_fi.path}:{node.lineno})"]
+
+        yield from _upcast_scan(upcast, ctx, fi.node, sd, why_chain, trace_fn)
+        yield from _pet_scan(pet, ctx, fi.node, sd, why_chain, trace_fn)

@@ -283,6 +283,11 @@ def check_project(index: ProjectIndex, contexts: dict) -> Iterator:
     findings.extend(_collective_findings(graph, contexts))
     findings.extend(_retrace_findings(graph, contexts))
 
+    # dtype-flow through call chains (helpers reached from reduced entries)
+    from .dtype_rules import dtype_project_findings
+
+    findings.extend(dtype_project_findings(graph, contexts))
+
     # dataflow rules re-run with the project view (duplicates of the
     # per-file pass are dropped by the caller)
     rng = RngKeyReuseRule()

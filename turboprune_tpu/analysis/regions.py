@@ -31,6 +31,7 @@ __all__ = [
     "dotted_name",
     "is_jit_wrapper",
     "is_tracing_call",
+    "partial_bindings",
     "unwrap_partial",
 ]
 
@@ -176,13 +177,24 @@ def _region_for_def(
 
 def unwrap_partial(node: ast.AST) -> ast.AST:
     """partial(f, ...) -> f (one level is all the repo uses)."""
+    return partial_bindings(node)[0]
+
+
+def partial_bindings(node: ast.AST) -> tuple:
+    """``partial(f, a, b, kw=c)`` -> ``(f, 2, frozenset({"kw"}))``; anything
+    that is not a partial call -> ``(node, 0, frozenset())``.
+
+    The bound count matters for scan bodies: ``lax.scan(partial(body,
+    model), init, xs)`` binds ``body``'s LEADING params as Python values at
+    trace time — only the params after them are traced (carry first)."""
     if (
         isinstance(node, ast.Call)
         and _is_partial(node.func)
         and node.args
     ):
-        return node.args[0]
-    return node
+        kw = frozenset(k.arg for k in node.keywords if k.arg)
+        return node.args[0], len(node.args) - 1, kw
+    return node, 0, frozenset()
 
 
 def donation_spec(call: ast.Call):
@@ -217,7 +229,7 @@ def build_jit_regions(tree: ast.Module) -> list:
         regions.setdefault((region.start, region.end), region)
 
     def add_callable(node: ast.AST, reason: str, static: list) -> None:
-        node = unwrap_partial(node)
+        node, n_bound, bound_kw = partial_bindings(node)
         if isinstance(node, ast.Lambda):
             add(
                 JitRegion(
@@ -226,12 +238,17 @@ def build_jit_regions(tree: ast.Module) -> list:
                     end=node.end_lineno or node.lineno,
                     reason=reason,
                     traced_params=frozenset(
-                        p for p in param_names(node) if p not in set(static)
+                        p for p in param_names(node)[n_bound:]
+                        if p not in set(static) | bound_kw
                     ),
                 )
             )
         elif isinstance(node, ast.Name) and node.id in defs:
-            add(_region_for_def(defs[node.id], reason, static))
+            fn = defs[node.id]
+            # partial-bound leading positionals (and bound keywords) are
+            # Python values at trace time, not traced operands
+            bound = set(param_names(fn)[:n_bound]) | set(bound_kw)
+            add(_region_for_def(fn, reason, list(static) + sorted(bound)))
 
     for node in ast.walk(tree):
         # -- decorated defs: @jax.jit / @partial(jax.jit, static_argnames=..)

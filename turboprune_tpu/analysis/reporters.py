@@ -1,13 +1,16 @@
-"""Finding reporters: human text and machine JSON.
+"""Finding reporters: human text, machine JSON, and SARIF for CI.
 
 Text goes to reviewers and CI logs (one grep-able line per finding, the
 same ``path:line:col:`` shape compilers use, so editors jump to it). JSON
 is the stable machine surface — its shape is pinned by
 tests/test_analysis.py::test_json_reporter_shape, so downstream tooling
 (dashboards, the check.sh gate, future pre-commit hooks) can rely on it.
-Waived findings are REPORTED, not hidden: a waiver is an argued exception,
-and the reason string travels with the finding so audits don't need to
-open the source.
+SARIF 2.1.0 (``--format sarif``) is the lingua franca CI annotation
+surface (GitHub code scanning et al.): unwaived findings become results,
+waived ones carry an ``inSource`` suppression so they render as
+acknowledged rather than vanish. Waived findings are REPORTED in every
+format, not hidden: a waiver is an argued exception, and the reason
+string travels with the finding so audits don't need to open the source.
 """
 
 from __future__ import annotations
@@ -15,9 +18,9 @@ from __future__ import annotations
 import json
 from collections import Counter
 
-from .core import AnalysisResult
+from .core import RULES, AnalysisResult
 
-__all__ = ["render_text", "render_json"]
+__all__ = ["render_text", "render_json", "render_sarif"]
 
 # v2: findings gained "trace" (interprocedural call-path, null for
 # per-file findings) when --project mode landed.
@@ -64,5 +67,79 @@ def render_json(result: AnalysisResult) -> str:
         },
         "findings": [f.as_dict() for f in result.findings],
         "unused_waivers": [w.as_dict() for w in result.unused_waivers],
+    }
+    return json.dumps(payload, indent=2, sort_keys=False)
+
+
+_SARIF_LEVELS = {"error": "error", "warning": "warning"}
+
+
+def render_sarif(result: AnalysisResult) -> str:
+    """SARIF 2.1.0, the minimal schema CI annotators consume."""
+    from .conf_rules import CONF_RULES
+
+    catalog = {**{r.id: r for r in RULES.values()}, **CONF_RULES}
+    seen_rules = sorted({f.rule for f in result.findings})
+    rules = [
+        {
+            "id": rid,
+            "shortDescription": {
+                "text": getattr(catalog.get(rid), "description", "") or rid
+            },
+        }
+        for rid in seen_rules
+    ]
+    rule_index = {rid: i for i, rid in enumerate(seen_rules)}
+    results = []
+    for f in result.findings:
+        entry = {
+            "ruleId": f.rule,
+            "ruleIndex": rule_index[f.rule],
+            "level": _SARIF_LEVELS.get(f.severity, "warning"),
+            "message": {
+                "text": f.message
+                + (
+                    " [call path: %s]" % " -> ".join(f.trace)
+                    if f.trace
+                    else ""
+                )
+            },
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f.file},
+                        "region": {
+                            "startLine": f.line,
+                            "startColumn": f.col + 1,
+                        },
+                    }
+                }
+            ],
+        }
+        if f.waived:
+            entry["suppressions"] = [
+                {
+                    "kind": "inSource",
+                    "justification": f.waiver_reason or "no reason given",
+                }
+            ]
+        results.append(entry)
+    payload = {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+            "Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "graftlint",
+                        "rules": rules,
+                    }
+                },
+                "results": results,
+            }
+        ],
     }
     return json.dumps(payload, indent=2, sort_keys=False)
