@@ -1,13 +1,15 @@
 #!/usr/bin/env bash
-# Pre-PR gate, seven stages:
+# Pre-PR gate, eight stages:
 #   1. graftlint --changed      — per-file rules on just the .py/.yaml
 #      files changed vs the merge-base with main (fast half; stays
 #      O(diff) as the repo grows)
 #   2. graftlint --project      — whole-project mode: per-file rules over
 #      everything PLUS the interprocedural call-chain analysis PLUS the
-#      conf/ <-> schema cross-checks. This is the real gate; it is the
-#      same invocation tests/test_analysis.py's self-gate pins at zero
-#      unwaived findings and zero stale waivers.
+#      conf/ <-> schema cross-checks PLUS the concurrency rules
+#      (unsynchronized-shared-mutation, lock-order-inversion,
+#      blocking-call-under-lock, check-then-act-race). This is the real
+#      gate; it is the same invocation tests/test_analysis.py's
+#      self-gate pins at zero unwaived findings and zero stale waivers.
 #   3. jaxpr dtype audit        — trace the synthetic-task train step
 #      under the default fp32 policy and diff the jaxpr's
 #      convert_element_type ops against the static dtype findings and
@@ -32,7 +34,14 @@
 #      Isolated (and jax-light, so it's fast) because loadgen bugs
 #      otherwise surface as flaky latency numbers in BENCH, not as a
 #      named failure.
-#   7. tier-1 fast tests        — the same command ROADMAP.md pins,
+#   7. graftsan smoke           — the runtime lock-order sanitizer drives
+#      the PrefetchEngine (pool decoders + transfer thread + racing
+#      closes) and a 2-model FleetEngine under 1-slot LRU churn with
+#      every package lock wrapped: an observed lock-order cycle, a
+#      self-deadlock, or a shared-write race the static layer never
+#      claimed (a lexical-model blind spot) fails the stage. Dynamic
+#      mirror of stage 2, exactly as stage 3 mirrors the dtype rules.
+#   8. tier-1 fast tests        — the same command ROADMAP.md pins,
 #      including its plugin surface (-p no:xdist -p no:randomly), so the
 #      gate and tier-1 agree on what "the suite" is.
 # Each stage prints its wall time (even when it fails, so slow-AND-broken
@@ -75,6 +84,9 @@ run_stage "serving-load smoke (drain + open-loop knee, fake engine)" \
     tests/test_fleet.py::TestGracefulDrain \
     tests/test_fleet.py::TestLoadgen -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
+
+run_stage "graftsan smoke (runtime lock-order + race sanitizer)" \
+    env JAX_PLATFORMS=cpu python -m turboprune_tpu.analysis --sanitize all
 
 run_stage "tier-1 tests (fast tier, CPU)" \
     env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

@@ -41,3 +41,8 @@ import pytest  # noqa: E402
 @pytest.fixture(scope="session")
 def devices():
     return jax.devices()
+
+# graftsan: opt-in runtime concurrency sanitizer fixture (asserts zero
+# observed lock-order cycles at teardown). Re-exported here so test files
+# get it without a root-level pytest_plugins declaration.
+from turboprune_tpu.analysis.pytest_plugin import graftsan  # noqa: E402, F401

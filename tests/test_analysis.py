@@ -336,12 +336,42 @@ FIXTURES = {
             return jnp.matmul(a, b, preferred_element_type=jnp.float32)
         """,
     ),
+    "cv-wait-no-predicate-loop": (
+        """
+        import threading
+
+        class Mailbox:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._items = []
+
+            def get(self):
+                with self._cv:
+                    if not self._items:
+                        self._cv.wait()
+                    return self._items.pop()
+        """,
+        """
+        import threading
+
+        class Mailbox:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._items = []
+
+            def get(self):
+                with self._cv:
+                    while not self._items:
+                        self._cv.wait()
+                    return self._items.pop()
+        """,
+    ),
 }
 
 
 class TestRuleFixtures:
     def test_rule_count_meets_floor(self):
-        assert len(RULES) >= 13
+        assert len(RULES) >= 18
         assert set(FIXTURES) <= set(RULES)
 
     @pytest.mark.parametrize("rule_id", sorted(FIXTURES))
@@ -2007,3 +2037,421 @@ class TestJaxprAudit:
     def test_audit_mutually_exclusive_with_project(self, capsys):
         assert cli_main(["--project", "--jaxpr-audit"]) == 2
         capsys.readouterr()
+
+
+# ----------------------------------------------- concurrency (PR 17)
+# Every project-only thread rule: (bad files that MUST trigger it, good
+# twin that MUST NOT). The pairs drive the full stack — thread-model
+# discovery, lockset interpretation, and the interproc hook.
+CONCURRENCY_FIXTURES = {
+    "unsynchronized-shared-mutation": (
+        {
+            "pkg/__init__.py": "",
+            "pkg/worker.py": """
+                import threading
+
+                class Counter:
+                    def __init__(self):
+                        self._thread = None
+                        self.total = 0
+
+                    def start(self):
+                        self._thread = threading.Thread(target=self._run)
+                        self._thread.start()
+
+                    def _run(self):
+                        for _ in range(100):
+                            self.total = self.total + 1
+
+                    def read(self):
+                        return self.total
+            """,
+        },
+        {
+            "pkg/__init__.py": "",
+            "pkg/worker.py": """
+                import threading
+
+                class Counter:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._thread = None
+                        self.total = 0
+
+                    def start(self):
+                        self._thread = threading.Thread(target=self._run)
+                        self._thread.start()
+
+                    def _run(self):
+                        for _ in range(100):
+                            with self._lock:
+                                self.total = self.total + 1
+
+                    def read(self):
+                        with self._lock:
+                            return self.total
+            """,
+        },
+    ),
+    "lock-order-inversion": (
+        {
+            "pkg/__init__.py": "",
+            "pkg/transfer.py": """
+                import threading
+
+                class Transfer:
+                    def __init__(self):
+                        self._audit = threading.Lock()
+                        self._books = threading.Lock()
+
+                    def deposit(self):
+                        with self._audit:
+                            with self._books:
+                                return 1
+
+                    def withdraw(self):
+                        with self._books:
+                            with self._audit:
+                                return 2
+            """,
+        },
+        {
+            "pkg/__init__.py": "",
+            "pkg/transfer.py": """
+                import threading
+
+                class Transfer:
+                    def __init__(self):
+                        self._audit = threading.Lock()
+                        self._books = threading.Lock()
+
+                    def deposit(self):
+                        with self._audit:
+                            with self._books:
+                                return 1
+
+                    def withdraw(self):
+                        with self._audit:
+                            with self._books:
+                                return 2
+            """,
+        },
+    ),
+    "blocking-call-under-lock": (
+        {
+            "pkg/__init__.py": "",
+            "pkg/refresh.py": """
+                import threading
+                from urllib.request import urlopen
+
+                class Refresher:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self.value = None
+
+                    def refresh(self):
+                        with self._lock:
+                            self.value = self._fetch()
+
+                    def _fetch(self):
+                        return urlopen("http://example.com").read()
+            """,
+        },
+        {
+            "pkg/__init__.py": "",
+            "pkg/refresh.py": """
+                import threading
+                from urllib.request import urlopen
+
+                class Refresher:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self.value = None
+
+                    def refresh(self):
+                        data = self._fetch()
+                        with self._lock:
+                            self.value = data
+
+                    def _fetch(self):
+                        return urlopen("http://example.com").read()
+            """,
+        },
+    ),
+    "check-then-act-race": (
+        {
+            "pkg/__init__.py": "",
+            "pkg/cache.py": """
+                import threading
+
+                class Cache:
+                    def __init__(self):
+                        self._cache = {}
+                        self._thread = None
+
+                    def start(self):
+                        self._thread = threading.Thread(target=self._refill)
+                        self._thread.start()
+
+                    def _refill(self):
+                        self.get("warm")
+
+                    def get(self, key):
+                        if key not in self._cache:
+                            self._cache[key] = len(key)
+                        return self._cache[key]
+            """,
+        },
+        {
+            "pkg/__init__.py": "",
+            "pkg/cache.py": """
+                import threading
+
+                class Cache:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._cache = {}
+                        self._thread = None
+
+                    def start(self):
+                        self._thread = threading.Thread(target=self._refill)
+                        self._thread.start()
+
+                    def _refill(self):
+                        self.get("warm")
+
+                    def get(self, key):
+                        with self._lock:
+                            if key not in self._cache:
+                                self._cache[key] = len(key)
+                            return self._cache[key]
+            """,
+        },
+    ),
+}
+
+
+class TestConcurrencyFixtures:
+    def test_rules_registered_as_project_only(self):
+        for rid in CONCURRENCY_FIXTURES:
+            assert rid in RULES, rid
+            assert RULES[rid].project_only, f"{rid} must be project-only"
+
+    @pytest.mark.parametrize("rule_id", sorted(CONCURRENCY_FIXTURES))
+    def test_bad_caught_with_trace(self, rule_id, tmp_path):
+        bad, _ = CONCURRENCY_FIXTURES[rule_id]
+        result = run_project(tmp_path, bad)
+        hits = unwaived(result, rule_id)
+        assert hits, f"{rule_id} missed its bad fixture"
+        assert any(f.trace for f in hits), (
+            f"{rule_id} fired without a thread/lock trace: "
+            f"{[(f.line, f.message) for f in hits]}"
+        )
+
+    @pytest.mark.parametrize("rule_id", sorted(CONCURRENCY_FIXTURES))
+    def test_good_twin_silent(self, rule_id, tmp_path):
+        _, good = CONCURRENCY_FIXTURES[rule_id]
+        result = run_project(tmp_path, good)
+        hits = unwaived(result, rule_id)
+        assert not hits, (
+            f"{rule_id} false-positived on its good twin: "
+            f"{[(f.file, f.line, f.message) for f in hits]}"
+        )
+
+    @pytest.mark.parametrize("rule_id", sorted(CONCURRENCY_FIXTURES))
+    def test_project_only_rules_silent_per_file(self, rule_id):
+        """The same bad source analyzed per-file must NOT fire: the
+        thread rules need the project thread model and would be pure
+        noise (or pure silence) per-file."""
+        bad, _ = CONCURRENCY_FIXTURES[rule_id]
+        for src in bad.values():
+            findings, _w = analyze_source(
+                textwrap.dedent(src), "lib/snippet.py"
+            )
+            assert not [f for f in findings if f.rule == rule_id]
+
+    def test_self_deadlock_single_lock_cycle(self, tmp_path):
+        files = {
+            "pkg/__init__.py": "",
+            "pkg/relock.py": """
+                import threading
+
+                class Box:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def outer(self):
+                        with self._lock:
+                            return self.inner()
+
+                    def inner(self):
+                        with self._lock:
+                            return 1
+            """,
+        }
+        hits = unwaived(
+            run_project(tmp_path, files), "lock-order-inversion"
+        )
+        assert hits and "self-deadlock" in hits[0].message
+
+    def test_rlock_reentry_is_silent(self, tmp_path):
+        files = {
+            "pkg/__init__.py": "",
+            "pkg/relock.py": """
+                import threading
+
+                class Box:
+                    def __init__(self):
+                        self._lock = threading.RLock()
+
+                    def outer(self):
+                        with self._lock:
+                            return self.inner()
+
+                    def inner(self):
+                        with self._lock:
+                            return 1
+            """,
+        }
+        assert not unwaived(
+            run_project(tmp_path, files), "lock-order-inversion"
+        )
+
+
+class TestGuardedByContract:
+    """# guarded-by: <lock> annotations switch the mutation rule from
+    heuristic to contract mode: EVERY access outside __init__ must hold
+    the named lock, spawning or not."""
+
+    def _files(self, body):
+        return {"pkg/__init__.py": "", "pkg/guarded.py": body}
+
+    def test_violation_fires_without_any_spawn(self, tmp_path):
+        files = self._files(
+            """
+            import threading
+
+            class Registry:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._entries = {}  # guarded-by: _lock
+
+                def add(self, k, v):
+                    with self._lock:
+                        self._entries[k] = v
+
+                def peek(self):
+                    return self._entries
+            """
+        )
+        hits = unwaived(
+            run_project(tmp_path, files), "unsynchronized-shared-mutation"
+        )
+        assert hits
+        assert "guarded-by" in hits[0].message
+        assert "peek" in hits[0].message
+        assert hits[0].trace
+
+    def test_honored_contract_is_silent(self, tmp_path):
+        files = self._files(
+            """
+            import threading
+
+            class Registry:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._entries = {}  # guarded-by: _lock
+
+                def add(self, k, v):
+                    with self._lock:
+                        self._entries[k] = v
+
+                def peek(self):
+                    with self._lock:
+                        return dict(self._entries)
+            """
+        )
+        assert not unwaived(
+            run_project(tmp_path, files), "unsynchronized-shared-mutation"
+        )
+
+    def test_inline_guard_does_not_leak_to_next_attribute(self, tmp_path):
+        """Regression: an INLINE guard comment annotates only its own
+        assignment; the attribute initialized on the next line must not
+        inherit the contract (only a standalone comment line above an
+        assignment annotates downward)."""
+        files = self._files(
+            """
+            import threading
+
+            class Pair:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._a = 0  # guarded-by: _lock
+                    self._b = 0
+
+                def bump_a(self):
+                    with self._lock:
+                        self._a = 1
+
+                def bump_b(self):
+                    self._b = 1
+            """
+        )
+        assert not unwaived(
+            run_project(tmp_path, files), "unsynchronized-shared-mutation"
+        )
+
+    def test_standalone_guard_line_above_applies(self, tmp_path):
+        files = self._files(
+            """
+            import threading
+
+            class Pair:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    # guarded-by: _lock
+                    self._a = 0
+
+                def bump_a(self):
+                    self._a = 1
+            """
+        )
+        hits = unwaived(
+            run_project(tmp_path, files), "unsynchronized-shared-mutation"
+        )
+        assert hits and "bump_a" in hits[0].message
+
+
+class TestParallelProjectMode:
+    """--jobs N: the per-file half of project mode fans out over a
+    process pool; findings must be byte-identical to the serial run."""
+
+    def _many_files(self, tmp_path):
+        files = {"pkg/__init__.py": ""}
+        for i in range(10):  # > core._MIN_PARALLEL_FILES
+            files[f"pkg/mod{i}.py"] = f"""
+                def load{i}(path):
+                    try:
+                        return open(path).read()
+                    except Exception:
+                        return None
+            """
+        return write_project(tmp_path, files)
+
+    def _key(self, f):
+        return (f.file, f.line, f.col, f.rule, f.message, f.waived)
+
+    def test_jobs_do_not_change_findings_or_order(self, tmp_path):
+        proj = self._many_files(tmp_path)
+        serial = analyze_project([proj], jobs=1)
+        parallel = analyze_project([proj], jobs=2)
+        assert [self._key(f) for f in serial.findings] == [
+            self._key(f) for f in parallel.findings
+        ]
+        assert len(serial.unwaived) == 10
+        assert serial.files_analyzed == parallel.files_analyzed
+
+    def test_cli_jobs_flag_parses(self):
+        args = build_parser().parse_args(["--project", "--jobs", "2"])
+        assert args.jobs == 2

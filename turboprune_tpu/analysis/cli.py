@@ -23,7 +23,12 @@ Four modes:
   ``file.py:builder`` entry) under ``--dtype-policy`` and diff the
   jaxpr's convert_element_type ops against the static dtype findings
   and waivers (jaxpr_audit.py). Needs jax importable; everything else
-  here runs with no accelerator stack.
+  here runs with no accelerator stack;
+* ``--sanitize [TARGET]`` — the runtime mirror of the concurrency rules
+  (sanitizer.py): wrap ``threading.Lock``/``RLock``/``Condition``, drive
+  the PrefetchEngine / FleetEngine load smokes (or a ``file.py:builder``
+  target), fail on observed lock-order cycles and on shared-attribute
+  races the static rules did not predict.
 
 With no paths it analyzes the installed ``turboprune_tpu`` package — the
 same invocation the self-gate test makes, so "the linter passes" means the
@@ -46,7 +51,8 @@ _EPILOG = """\
 exit codes:
   0  analyzed clean: zero unwaived findings (jaxpr audit: clean diff)
   1  at least one unwaived finding (jaxpr audit: unexplained upcast or
-     unwaived static dtype finding)
+     unwaived static dtype finding; sanitize: observed lock-order cycle
+     or a race with no static finding)
   2  usage or environment error (bad path, unknown rule in --select,
      git unavailable for --changed, jax unavailable for --jaxpr-audit)
 """
@@ -114,6 +120,32 @@ def build_parser() -> argparse.ArgumentParser:
             "'pkg.module:builder' returning (fn, args)) under "
             "--dtype-policy and diff jaxpr convert_element_type ops "
             "against static dtype findings and waivers (needs jax)"
+        ),
+    )
+    p.add_argument(
+        "--sanitize",
+        nargs="?",
+        const="all",
+        metavar="TARGET",
+        help=(
+            "graftsan runtime concurrency sanitizer: wrap "
+            "threading.Lock/RLock/Condition, drive TARGET ('pipeline', "
+            "'fleet', 'all', or 'file.py:builder' returning a callable) "
+            "under threaded load, fail on observed lock-order cycles and "
+            "on shared-attribute races with no static "
+            "unsynchronized-shared-mutation finding (a sanitizer-only "
+            "race is a static blind spot)"
+        ),
+    )
+    p.add_argument(
+        "--jobs",
+        type=int,
+        default=0,
+        metavar="N",
+        help=(
+            "process-pool width for --project's per-file half "
+            "(0 = one per CPU, 1 = serial; finding order is identical "
+            "either way)"
         ),
     )
     p.add_argument(
@@ -214,6 +246,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             ("--project", args.project),
             ("--changed", bool(args.changed)),
             ("--jaxpr-audit", bool(args.jaxpr_audit)),
+            ("--sanitize", bool(args.sanitize)),
         )
         if on
     ]
@@ -249,6 +282,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(f"graftlint --jaxpr-audit: {e}", file=sys.stderr)
             return 2
 
+    if args.sanitize:
+        from .sanitizer import SanitizeError, run_sanitize
+
+        try:
+            return run_sanitize(args.sanitize)
+        except SanitizeError as e:
+            print(f"graftlint --sanitize: {e}", file=sys.stderr)
+            return 2
+
     try:
         if args.changed:
             if args.paths:
@@ -274,7 +316,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             result = analyze_files(files, select=select)
         elif args.project:
             result = analyze_project(
-                args.paths or _default_project_paths(), select=select
+                args.paths or _default_project_paths(),
+                select=select,
+                jobs=args.jobs or None,
             )
         else:
             result = analyze_paths(

@@ -33,6 +33,7 @@ from __future__ import annotations
 import ast
 import dataclasses
 import io
+import os
 import re
 import tokenize
 from pathlib import Path
@@ -208,6 +209,10 @@ class Rule:
     description: str = ""
     # True: rule does not run on tests/conftest files (see is_test_file).
     skip_in_tests: bool = False
+    # True: rule needs the project layer (symbol table / thread model) and
+    # fires only from check_project; the per-file driver skips it and
+    # per-file stale-waiver accounting treats its waivers as out of scope.
+    project_only: bool = False
 
     def check(self, ctx: ModuleContext) -> Iterator[Finding]:
         raise NotImplementedError
@@ -254,7 +259,9 @@ class AnalysisResult:
             return unused
         from .conf_rules import CONF_RULES  # lazy: conf_rules imports core
 
-        project_only = set(CONF_RULES)
+        project_only = set(CONF_RULES) | {
+            rid for rid, r in RULES.items() if r.project_only
+        }
         return [
             w
             for w in unused
@@ -303,6 +310,8 @@ def _run_rules_dedup(ctx: ModuleContext, select=None) -> list:
     (nested jit regions can surface the same node twice)."""
     findings = []
     for rule in RULES.values():
+        if rule.project_only:
+            continue  # fires from check_project, never per-file
         if select and rule.id not in select:
             continue
         if rule.skip_in_tests and ctx.is_test:
@@ -502,16 +511,75 @@ def _apply_waivers_by_file(findings: list, waivers: list) -> list:
     return out
 
 
+def _project_file_scan(args) -> tuple:
+    """Process-pool worker: parse one file and run the per-file rules.
+
+    Returns ``(file, source, findings, waivers, parsed)``. Module-level
+    (picklable) on purpose; the lazy imports re-register the rule set when
+    the pool uses the spawn start method (fork inherits it)."""
+    path, select = args
+    from . import concurrency_rules, dtype_rules, rules  # noqa: F401
+
+    p = Path(path)
+    source = p.read_text(encoding="utf-8")
+    file = str(p)
+    waivers = parse_waivers(source, file)
+    try:
+        ctx = ModuleContext(file, source)
+    except SyntaxError as e:
+        return file, source, [_parse_error_finding(file, e)], waivers, False
+    return file, source, _run_rules_dedup(ctx, select), waivers, True
+
+
+# Below this, process-pool startup dominates: run serial.
+_MIN_PARALLEL_FILES = 8
+
+
+def _scan_project_files(py_files, select, jobs) -> list:
+    """Per-file scans for project mode, parallel when it pays.
+
+    Output order equals input order either way (``Executor.map`` preserves
+    it), and the driver's final sort makes finding order deterministic, so
+    ``--jobs`` can never change what check.sh diffs. Pool failures
+    (sandboxes without semaphores, missing /dev/shm) fall back to serial."""
+    if jobs is None or jobs <= 0:
+        jobs = os.cpu_count() or 1
+    args = [(str(f), tuple(select) if select else None) for f in py_files]
+    if jobs > 1 and len(args) >= _MIN_PARALLEL_FILES:
+        import multiprocessing
+        from concurrent.futures import ProcessPoolExecutor
+
+        try:
+            # Spawn, not fork: analyze_project is also called from inside
+            # test processes that have already imported jax (multithreaded
+            # — forking it can deadlock the child). _project_file_scan
+            # lazy-imports the rule modules precisely so spawned workers
+            # can bootstrap from an empty interpreter.
+            with ProcessPoolExecutor(
+                max_workers=jobs,
+                mp_context=multiprocessing.get_context("spawn"),
+            ) as pool:
+                return list(pool.map(_project_file_scan, args, chunksize=4))
+        except (OSError, PermissionError, ImportError):
+            pass
+    return [_project_file_scan(a) for a in args]
+
+
 def analyze_project(
     paths: Iterable,
     select: Optional[Sequence[str]] = None,
+    jobs: Optional[int] = None,
 ) -> AnalysisResult:
     """Whole-project mode: per-file rules PLUS the interprocedural layer
     (symbol table + call graph; rules fire through call chains with a
     call-path trace) PLUS the config static analysis over ``*.yaml`` files
     against the schema dataclasses. Waivers come from Python comments and
     from ``# graftlint: disable=...`` YAML comments alike; stale-waiver
-    accounting spans both layers (this is the mode the pre-PR gate runs)."""
+    accounting spans both layers (this is the mode the pre-PR gate runs).
+
+    ``jobs`` widens the per-file half across a process pool (None/0 =
+    one per CPU, 1 = serial); the interprocedural layer stays in-process
+    on a re-parse of the same sources."""
     from .conf_rules import analyze_conf
     from .interproc import check_project
     from .project import ProjectIndex
@@ -520,17 +588,13 @@ def analyze_project(
     raw_findings: list = []
     all_waivers: list = []
     contexts: dict = {}
-    for f in py_files:
-        source = f.read_text(encoding="utf-8")
-        file = str(f)
-        all_waivers.extend(parse_waivers(source, file))
-        try:
-            ctx = ModuleContext(file, source)
-        except SyntaxError as e:
-            raw_findings.append(_parse_error_finding(file, e))
-            continue
-        contexts[file] = ctx
-        raw_findings.extend(_run_rules_dedup(ctx, select))
+    for file, source, findings, waivers, parsed in _scan_project_files(
+        py_files, select, jobs
+    ):
+        all_waivers.extend(waivers)
+        raw_findings.extend(findings)
+        if parsed:
+            contexts[file] = ModuleContext(file, source)
 
     # interprocedural layer (dedup: a site already flagged per-file keeps
     # its per-file finding; the interprocedural twin is dropped)

@@ -288,6 +288,11 @@ def check_project(index: ProjectIndex, contexts: dict) -> Iterator:
 
     findings.extend(dtype_project_findings(graph, contexts))
 
+    # concurrency layer: thread model + locksets (project-only rules)
+    from .concurrency_rules import concurrency_findings
+
+    findings.extend(concurrency_findings(index, contexts))
+
     # dataflow rules re-run with the project view (duplicates of the
     # per-file pass are dropped by the caller)
     rng = RngKeyReuseRule()

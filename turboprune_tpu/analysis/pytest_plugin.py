@@ -1,0 +1,29 @@
+"""Pytest integration for graftsan (sanitizer.py).
+
+``graftsan`` is a fixture, not an autouse hook: a test opts in, drives
+whatever concurrent machinery it wants through the package's real code
+paths, and gets the observed lock-order graph and write log to assert on.
+At teardown the fixture fails the test on any observed lock-order cycle —
+the property no test should ever waive — while race verdicts are left to
+the test body (the CLI's ``--sanitize`` owns the static-diff contract).
+
+tests/conftest.py re-exports the fixture so every test file sees it
+without a ``pytest_plugins`` declaration.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from .sanitizer import Graftsan
+
+
+@pytest.fixture
+def graftsan():
+    """Yields an ACTIVE Graftsan (factories patched); asserts zero observed
+    lock-order cycles at teardown."""
+    san = Graftsan()
+    with san:
+        yield san
+    cycles = san.cycles()
+    assert not cycles, f"graftsan observed lock-order cycle(s): {cycles}"

@@ -96,10 +96,10 @@ class PrefetchEngine:
         self._group = group
         self._out: queue.Queue = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
-        self._closed = False
-        self._finished = False
+        self._closed = False  # guarded-by: _lock
+        self._finished = False  # guarded-by: _lock
         self._lock = threading.Lock()
-        self._stats = {
+        self._stats = {  # guarded-by: _lock
             "batches_decoded": 0,
             "items_emitted": 0,
             "decode_wait_s": 0.0,
@@ -181,7 +181,9 @@ class PrefetchEngine:
         return self
 
     def __next__(self):
-        if self._finished:
+        with self._lock:
+            finished = self._finished
+        if finished:
             raise StopIteration
         t0 = time.perf_counter()
         while True:
@@ -194,17 +196,20 @@ class PrefetchEngine:
                     # before exiting; reaching here means it was killed
                     # abnormally (interpreter teardown) — fail loudly
                     # rather than block forever.
-                    self._finished = True
+                    with self._lock:
+                        self._finished = True
                     raise RuntimeError(
                         "prefetch pipeline transfer thread died without "
                         "signalling completion"
                     ) from None
         self._bump("consumer_wait_s", time.perf_counter() - t0)
         if item is _DONE:
-            self._finished = True
+            with self._lock:
+                self._finished = True
             raise StopIteration
         if isinstance(item, _Failure):
-            self._finished = True
+            with self._lock:
+                self._finished = True
             self.close()
             if isinstance(item.exc, StopIteration):
                 # A StopIteration raised inside __next__ would silently end
@@ -221,10 +226,14 @@ class PrefetchEngine:
         with the transfer thread blocked on a full output queue or on an
         in-flight decode (pending tasks are cancelled, running ones are
         waited out)."""
-        if self._closed:
-            return
-        self._closed = True
-        self._finished = True
+        # Check-then-act under the lock: the consumer's failure path, the
+        # generator's finally, and __del__ can all race into close(); only
+        # one of them may run the join/shutdown sequence.
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._finished = True
         self._stop.set()
         # Unblock a transfer thread stuck in _put (bounded queue full).
         while True:

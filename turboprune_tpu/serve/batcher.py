@@ -88,9 +88,17 @@ class DynamicBatcher:
         self.metrics = metrics
         self._queue: queue.Queue[_Request] = queue.Queue(maxsize=queue_depth)
         self._stop = threading.Event()
-        self._draining = False
-        self._outstanding = 0  # accepted but unanswered requests
+        # Event, not a bare bool: set on the shutdown path, read by every
+        # submitter thread — an Event makes the write visible immediately.
+        self._draining = threading.Event()
+        self._outstanding = 0  # guarded-by: _outstanding_lock
         self._outstanding_lock = threading.Lock()
+        # Admission barrier: submit() enqueues under this lock after
+        # re-checking _draining; close() takes it (after stopping the
+        # worker) around the straggler-fail sweep. Without it a submitter
+        # that passed the draining check could land a request in the queue
+        # AFTER the sweep — accepted, but never answered.
+        self._admit_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
 
     # ----------------------------------------------------------- lifecycle
@@ -108,7 +116,7 @@ class DynamicBatcher:
         accepted (queued or mid-flush) within ``deadline_s``, then close.
         Returns {"drained": bool, "unanswered": n} — unanswered requests
         past the deadline get the close-time RuntimeError."""
-        self._draining = True
+        self._draining.set()
         deadline = time.perf_counter() + max(0.0, float(deadline_s))
         while time.perf_counter() < deadline:
             with self._outstanding_lock:
@@ -122,22 +130,28 @@ class DynamicBatcher:
         return {"drained": unanswered == 0, "unanswered": unanswered}
 
     def close(self, timeout: float = 5.0) -> None:
-        self._draining = True
+        self._draining.set()
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout)
         # Fail any stragglers instead of leaving callers blocked forever.
-        while True:
-            try:
-                req = self._queue.get_nowait()
-            except queue.Empty:
-                break
-            self._finish(req, error=RuntimeError("batcher closed"))
+        # Under _admit_lock: a submitter mid-admission finishes (its request
+        # lands before the sweep and is failed here); any submitter arriving
+        # after the sweep re-checks _draining under the lock and sheds.
+        with self._admit_lock:
+            while True:
+                try:
+                    req = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                self._finish(req, error=RuntimeError("batcher closed"))
         if self._pool is not None:
             # In-flight replica flushes resolve their own futures; wait so
             # close() returning means no thread still touches the engines.
+            # NEVER rebind _pool to None: the worker reads it after its
+            # None-check, and close() racing that window (join timed out)
+            # would hand it a vanished attribute. shutdown() is idempotent.
             self._pool.shutdown(wait=True)
-            self._pool = None
 
     @property
     def queue_depth(self) -> int:
@@ -154,7 +168,7 @@ class DynamicBatcher:
         """Enqueue one request; returns a Future resolving to its logits.
         Raises QueueFullError when the bounded queue is at capacity or the
         batcher is draining."""
-        if self._draining or self._stop.is_set():
+        if self._draining.is_set() or self._stop.is_set():
             if self.metrics:
                 self.metrics.inc("rejected_total")
             raise QueueFullError("batcher is draining — shed load")
@@ -171,18 +185,27 @@ class DynamicBatcher:
                 f" with k >= 1, got {x.shape}"
             )
         req = _Request(x, Future(), time.perf_counter())
-        with self._outstanding_lock:
-            self._outstanding += 1
-        try:
-            self._queue.put_nowait(req)
-        except queue.Full:
+        with self._admit_lock:
+            # Re-check under the admission lock: once close() has swept the
+            # queue (it holds this lock to do so), every later submitter
+            # must see _draining set here and shed instead of enqueueing
+            # into a dead queue.
+            if self._draining.is_set() or self._stop.is_set():
+                if self.metrics:
+                    self.metrics.inc("rejected_total")
+                raise QueueFullError("batcher is draining — shed load")
             with self._outstanding_lock:
-                self._outstanding -= 1
-            if self.metrics:
-                self.metrics.inc("rejected_total")
-            raise QueueFullError(
-                f"request queue full ({self._queue.maxsize} pending)"
-            ) from None
+                self._outstanding += 1
+            try:
+                self._queue.put_nowait(req)
+            except queue.Full:
+                with self._outstanding_lock:
+                    self._outstanding -= 1
+                if self.metrics:
+                    self.metrics.inc("rejected_total")
+                raise QueueFullError(
+                    f"request queue full ({self._queue.maxsize} pending)"
+                ) from None
         if self.metrics:
             self.metrics.inc("requests_total")
             self.metrics.set_gauge("queue_depth", self._queue.qsize())

@@ -216,6 +216,7 @@ class InferenceEngine:
             )
             t0 = time.perf_counter()
             # graftlint: disable=retrace-hazard -- AOT by design: lower() runs once per bucket shape, guarded by the _compiled cache + _compile_lock double-check above
+            # graftlint: disable=blocking-call-under-lock -- single-flight compile IS the point of _compile_lock: concurrent requests for the same cold bucket must wait for one trace, not each run their own; other buckets' hits stay lock-free via the fast path above
             lowered = jax.jit(self._apply).lower(self._variables, spec)
             fn = None
             key = None
@@ -231,6 +232,7 @@ class InferenceEngine:
                 if self.metrics:
                     self.metrics.inc(f"aot_cache_{status}_total")
             if fn is None:
+                # graftlint: disable=blocking-call-under-lock -- single-flight XLA compile under _compile_lock, same contract as the lower() above; holding the lock for seconds on a cold bucket is the chosen trade
                 fn = lowered.compile()
                 if self.metrics:
                     self.metrics.inc("xla_compiles_total")

@@ -65,6 +65,7 @@ class AOTExecutableCache:
         self.dir = Path(cache_dir)
         self.dir.mkdir(parents=True, exist_ok=True)
         self._lock = threading.Lock()
+        # guarded-by: _lock
         self._counters = {HIT: 0, MISS: 0, BYPASS: 0, CORRUPT: 0, "stores": 0}
 
     # --------------------------------------------------------------- keying

@@ -85,10 +85,10 @@ class FleetEngine:
         self.default_route = default_route
         self.pinned_model = pinned_model
         self.backend = backend
-        self._residents: "OrderedDict[str, _Resident]" = OrderedDict()
+        self._residents: "OrderedDict[str, _Resident]" = OrderedDict()  # guarded-by: _lock
         self._lock = threading.RLock()  # protects the resident map + LRU
-        self._build_locks: dict[str, threading.Lock] = {}
-        self._closed = False
+        self._build_locks: dict[str, threading.Lock] = {}  # guarded-by: _lock
+        self._closed = False  # guarded-by: _lock
         # Fail fast on a bad route config instead of on the first request.
         self.default_model = registry.default_id(default_route, pinned_model)
         if warmup:

@@ -56,14 +56,14 @@ class ServeMetrics:
     ):
         self.labels = tuple((str(k), str(v)) for k, v in labels)
         self._lock = threading.Lock()
-        self._counters: dict[str, float] = {}
-        self._gauges: dict[str, float] = {}
+        self._counters: dict[str, float] = {}  # guarded-by: _lock
+        self._gauges: dict[str, float] = {}  # guarded-by: _lock
         # counts[i] = observations <= LATENCY_BUCKETS_MS[i]; last slot = +Inf.
-        self._latency_counts = [0] * (len(LATENCY_BUCKETS_MS) + 1)
-        self._latency_sum_ms = 0.0
-        self._latency_total = 0
-        self._latency_window: deque[float] = deque(maxlen=window)
-        self._batch_window: deque[int] = deque(maxlen=window)
+        self._latency_counts = [0] * (len(LATENCY_BUCKETS_MS) + 1)  # guarded-by: _lock
+        self._latency_sum_ms = 0.0  # guarded-by: _lock
+        self._latency_total = 0  # guarded-by: _lock
+        self._latency_window: deque[float] = deque(maxlen=window)  # guarded-by: _lock
+        self._batch_window: deque[int] = deque(maxlen=window)  # guarded-by: _lock
 
     # ------------------------------------------------------------ mutation
     def inc(self, name: str, value: float = 1.0) -> None:
@@ -244,7 +244,7 @@ class MetricsHub:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._instances: dict[str, ServeMetrics] = {}
+        self._instances: dict[str, ServeMetrics] = {}  # guarded-by: _lock
 
     def get(self, model: str = "") -> ServeMetrics:
         with self._lock:
