@@ -1,5 +1,5 @@
 #!/usr/bin/env bash
-# Pre-PR gate, eight stages:
+# Pre-PR gate, ten stages:
 #   1. graftlint --changed      — per-file rules on just the .py/.yaml
 #      files changed vs the merge-base with main (fast half; stays
 #      O(diff) as the repo grows)
@@ -41,7 +41,18 @@
 #      self-deadlock, or a shared-write race the static layer never
 #      claimed (a lexical-model blind spot) fails the stage. Dynamic
 #      mirror of stage 2, exactly as stage 3 mirrors the dtype rules.
-#   8. tier-1 fast tests        — the same command ROADMAP.md pins,
+#   8. exec-manifest round-trip — rebuild the static compile-surface
+#      manifest (jit entries x compile sites x bucket sets x plan kinds)
+#      and diff it against the checked-in
+#      turboprune_tpu/analysis/exec_manifest.json. Drift means code grew
+#      or moved an executable the manifest doesn't know: re-emit with
+#      --exec-manifest emit and review the diff like a lockfile change.
+#   9. compile audit            — the runtime mirror of stage 8: patch
+#      jax's backend_compile, drive the serving engine (warmup + padded
+#      predict) and the jitted train step, and fail on any XLA compile
+#      not attributed to a manifest entry, or any compiled (plan,
+#      bucket) outside the declared surface.
+#  10. tier-1 fast tests        — the same command ROADMAP.md pins,
 #      including its plugin surface (-p no:xdist -p no:randomly), so the
 #      gate and tier-1 agree on what "the suite" is.
 # Each stage prints its wall time (even when it fails, so slow-AND-broken
@@ -87,6 +98,12 @@ run_stage "serving-load smoke (drain + open-loop knee, fake engine)" \
 
 run_stage "graftsan smoke (runtime lock-order + race sanitizer)" \
     env JAX_PLATFORMS=cpu python -m turboprune_tpu.analysis --sanitize all
+
+run_stage "exec-manifest round-trip (static compile surface vs checked-in)" \
+    python -m turboprune_tpu.analysis --exec-manifest diff
+
+run_stage "compile audit (runtime compiles attributed to the manifest)" \
+    env JAX_PLATFORMS=cpu python -m turboprune_tpu.analysis --compile-audit all
 
 run_stage "tier-1 tests (fast tier, CPU)" \
     env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

@@ -366,12 +366,132 @@ FIXTURES = {
                     return self._items.pop()
         """,
     ),
+    "shape-varying-jit-arg": (
+        """
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x * 2
+
+        def run(x, lengths):
+            for n in lengths:
+                x = step(x[:n])
+            return x
+        """,
+        """
+        import jax
+
+        BUCKETS = (8, 32, 128)
+
+        @jax.jit
+        def step(x):
+            return x * 2
+
+        def run(x, idxs):
+            for i in idxs:
+                b = BUCKETS[i]
+                x = step(x[:b])
+            return x
+        """,
+    ),
+    "concrete-shape-branch": (
+        """
+        import jax
+
+        @jax.jit
+        def forward(x):
+            if x.shape[0] > 4:
+                return x * 2
+            return x
+        """,
+        """
+        import jax
+
+        @jax.jit
+        def forward(x):
+            return x * 2
+
+        def dispatch(x):
+            if x.shape[0] > 4:
+                return forward(x)
+            return x
+        """,
+    ),
+    "bucket-set-escape": (
+        """
+        BUCKETS = (1, 8, 32)
+
+        class Engine:
+            def warmup(self):
+                for b in BUCKETS:
+                    self._executable(b)
+                self._executable(64)
+        """,
+        """
+        BUCKETS = (1, 8, 32)
+
+        class Engine:
+            def warmup(self):
+                for b in BUCKETS:
+                    self._executable(b)
+                self._executable(32)
+        """,
+    ),
+    "unpinned-donation-shape": (
+        """
+        import jax
+        import jax.numpy as jnp
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def update(state, grad):
+            return state + grad
+
+        def run():
+            a = update(jnp.zeros((4, 8)), jnp.ones((4, 8)))
+            b = update(jnp.zeros((8, 8)), jnp.ones((8, 8)))
+            return a, b
+        """,
+        """
+        import jax
+        import jax.numpy as jnp
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def update(state, grad):
+            return state + grad
+
+        def run():
+            a = update(jnp.zeros((8, 8)), jnp.ones((8, 8)))
+            b = update(jnp.zeros((8, 8)), jnp.ones((8, 8)))
+            return a, b
+        """,
+    ),
+    "rank-change-into-cache": (
+        """
+        import jax.numpy as jnp
+
+        class Engine:
+            def lookup(self, x):
+                x = jnp.reshape(x, (-1,))
+                return self._exec_cache[x.shape[0]]
+        """,
+        """
+        import jax.numpy as jnp
+
+        class Engine:
+            def lookup(self, x):
+                x = jnp.reshape(x, (-1,))
+                return self._exec_cache[x.shape]
+        """,
+    ),
 }
 
 
 class TestRuleFixtures:
     def test_rule_count_meets_floor(self):
-        assert len(RULES) >= 18
+        assert len(RULES) >= 23
         assert set(FIXTURES) <= set(RULES)
 
     @pytest.mark.parametrize("rule_id", sorted(FIXTURES))
@@ -1497,6 +1617,118 @@ class TestConfRules:
 
 
 # =================================================================
+# Shape-flow lattice: edge cases the shape-rule FIXTURES don't pin
+# =================================================================
+
+
+class TestShapeLattice:
+    """ScopeShapes/lattice semantics: the honest-`?` contract under
+    partial knowledge — folds only happen when everything is known."""
+
+    @staticmethod
+    def _returns(src, seed=None):
+        import ast
+
+        from turboprune_tpu.analysis.shape_flow import ScopeShapes
+
+        tree = ast.parse(textwrap.dedent(src))
+        fn = next(
+            n for n in ast.walk(tree) if isinstance(n, ast.FunctionDef)
+        )
+        return [v for _, v in ScopeShapes(fn, seed=seed).returns]
+
+    def test_reshape_minus_one_folds_only_when_total_known(self):
+        from turboprune_tpu.analysis.shape_flow import DIM_UNKNOWN, ArrayVal
+
+        (v,) = self._returns(
+            """
+            def f():
+                x = jnp.zeros((4, 8))
+                return x.reshape(2, -1)
+            """
+        )
+        assert v.shape == (2, 16)
+        # one unknown dim poisons the product: -1 must stay honest
+        (v,) = self._returns(
+            "def f(x):\n    return x.reshape(-1)\n",
+            seed={"x": ArrayVal((8, "n"), "x")},
+        )
+        assert v.shape == (DIM_UNKNOWN,)
+
+    def test_broadcast_disagreement_collapses_to_unknown(self):
+        from turboprune_tpu.analysis.shape_flow import (
+            DIM_UNKNOWN,
+            ArrayVal,
+            broadcast_shapes,
+        )
+
+        assert broadcast_shapes((4, 8), (3, 8)) == (DIM_UNKNOWN, 8)
+        assert broadcast_shapes((1, 8), (5, 8)) == (5, 8)
+        assert broadcast_shapes(("n", 8), ("n", 8)) == ("n", 8)
+        assert broadcast_shapes(("n", 8), (4, 8)) == (DIM_UNKNOWN, 8)
+        # through the interpreter: a known-1 dim yields, symbols survive
+        (v,) = self._returns(
+            "def f(a, b):\n    return a + b\n",
+            seed={
+                "a": ArrayVal((4, 1), "a"),
+                "b": ArrayVal((4, "k"), "b"),
+            },
+        )
+        assert v.shape == (4, "k")
+
+    def test_branch_join_collapses_disagreeing_dim(self):
+        from turboprune_tpu.analysis.shape_flow import DIM_UNKNOWN
+
+        (v,) = self._returns(
+            """
+            def f(flag):
+                if flag:
+                    x = jnp.zeros((4, 8))
+                else:
+                    x = jnp.zeros((6, 8))
+                return x
+            """
+        )
+        assert v.shape == (DIM_UNKNOWN, 8)
+
+    def test_scan_carry_keeps_init_shape_ys_stay_unknown(self):
+        carry, ys = self._returns(
+            """
+            def f(xs):
+                init = jnp.zeros((4, 8))
+                carry, ys = jax.lax.scan(step, init, xs)
+                return carry
+                return ys
+            """
+        )
+        # dead second return is fine for the interpreter: both collect
+        assert carry.shape == (4, 8)  # rank-stable across every step
+        assert ys is None  # stacked ys: honestly untracked
+
+    def test_concatenate_mixed_known_and_unknown_dims(self):
+        from turboprune_tpu.analysis.shape_flow import DIM_UNKNOWN, ArrayVal
+
+        (v,) = self._returns(
+            "def f(a, b):\n    return jnp.concatenate((a, b))\n",
+            seed={
+                "a": ArrayVal((3, 8), "a"),
+                "b": ArrayVal((4, 8), "b"),
+            },
+        )
+        assert v.shape == (7, 8)  # both known: the axis dim folds
+        (v,) = self._returns(
+            "def f(a, b):\n    return jnp.concatenate((a, b))\n",
+            seed={
+                "a": ArrayVal((4, 8), "a"),
+                "b": ArrayVal(("n", 8), "b"),
+            },
+        )
+        # unknown contribution poisons ONLY the concat axis; the joined
+        # non-axis dim stays known
+        assert v.shape == (DIM_UNKNOWN, 8)
+
+
+# =================================================================
 # PR 12: dtype-flow analysis, SARIF, merge-base --changed, jaxpr audit
 # =================================================================
 
@@ -2455,3 +2687,147 @@ class TestParallelProjectMode:
     def test_cli_jobs_flag_parses(self):
         args = build_parser().parse_args(["--project", "--jobs", "2"])
         assert args.jobs == 2
+
+
+# =================================================================
+# Rule-docs generation + executable-set manifest + compile audit
+# =================================================================
+
+
+class TestRuleDocs:
+    def test_every_rule_documents_why(self):
+        """doc_why is load-bearing: it becomes the README catalog's third
+        column. A rule without one ships an empty cell."""
+        for rule in RULES.values():
+            assert rule.doc_why, f"{rule.id} has no doc_why"
+        for rule in CONF_RULES.values():
+            assert rule.doc_why, f"{rule.id} has no doc_why"
+
+    def test_readme_block_matches_generated(self):
+        """The staleness self-gate: the marked block in README.md must be
+        byte-identical to what --rule-docs generates from the registries."""
+        from turboprune_tpu.analysis.reporters import render_rule_docs
+
+        text = (REPO / "README.md").read_text(encoding="utf-8")
+        begin = text.index("rule-docs:begin")
+        begin = text.index("\n", begin) + 1
+        end = text.index("<!-- rule-docs:end -->")
+        assert text[begin:end] == render_rule_docs(), (
+            "README rule catalog is stale — regenerate with "
+            "`python -m turboprune_tpu.analysis --rule-docs` and paste it "
+            "between the rule-docs markers"
+        )
+
+    def test_rule_docs_covers_every_registered_rule(self):
+        from turboprune_tpu.analysis.reporters import render_rule_docs
+
+        docs = render_rule_docs()
+        for rid in list(RULES) + list(CONF_RULES):
+            assert f"`{rid}`" in docs
+
+    def test_rule_docs_cli(self, capsys):
+        assert cli_main(["--rule-docs"]) == 0
+        out = capsys.readouterr().out
+        assert out.startswith("| Rule | Severity | Catches |")
+
+
+class TestExecManifest:
+    def test_build_is_deterministic_and_repo_relative(self):
+        from turboprune_tpu.analysis.exec_manifest import build_manifest
+
+        m1, m2 = build_manifest(), build_manifest()
+        assert m1 == m2
+        for e in m1["entries"] + m1["compile_sites"]:
+            assert not Path(e["file"]).is_absolute()
+            assert "\\" not in e["file"]
+
+    def test_manifest_knows_the_serving_surface(self):
+        from turboprune_tpu.analysis.exec_manifest import (
+            build_manifest,
+            executable_names,
+        )
+
+        m = build_manifest()
+        assert set(m["plan_kinds"]) == {"compact", "masked", "nm"}
+        assert set(m["buckets"]) == {1, 8, 32, 128}
+        names = executable_names(m)
+        # the factory-resolved eval step and the engine's jit target
+        assert {"train_step", "eval_step", "_apply"} <= names
+        # the engine's declared bucket table is one of the bucket sets
+        assert any(
+            k.endswith("serve/engine.py:DEFAULT_BUCKETS")
+            for k in m["bucket_sets"]
+        )
+
+    def test_covers_contract(self):
+        from turboprune_tpu.analysis.exec_manifest import covers
+
+        m = {"plan_kinds": {"masked": "x:1"}, "buckets": [1, 8]}
+        assert covers(m, "masked", 8)
+        assert not covers(m, "masked", 4)  # undeclared bucket
+        assert not covers(m, "compact", 8)  # undeclared plan kind
+
+    def test_checked_in_manifest_diff_clean(self, capsys):
+        """The check.sh round-trip stage, as a test: the committed JSON
+        must match a fresh build (exit 1 + itemized drift otherwise)."""
+        from turboprune_tpu.analysis.exec_manifest import run_exec_manifest
+
+        assert run_exec_manifest("diff") == 0
+        assert "clean" in capsys.readouterr().out
+
+    def test_diff_itemizes_drift(self, tmp_path, capsys, monkeypatch):
+        import turboprune_tpu.analysis.exec_manifest as em
+
+        stale = json.loads(
+            json.dumps(em.load_manifest() or em.build_manifest())
+        )
+        stale["buckets"] = [1, 8]
+        stale["plan_kinds"].pop("nm", None)
+        p = tmp_path / "exec_manifest.json"
+        p.write_text(json.dumps(stale))
+        monkeypatch.setattr(em, "MANIFEST_PATH", p)
+        assert em.run_exec_manifest("diff") == 1
+        out = capsys.readouterr().out
+        assert "nm" in out and "drift" in out.lower()
+
+    def test_unknown_mode_is_usage_error(self):
+        from turboprune_tpu.analysis.exec_manifest import run_exec_manifest
+
+        with pytest.raises(ValueError, match="bogus"):
+            run_exec_manifest("bogus")
+
+
+class TestCompileAudit:
+    def test_runtime_name_mangles_like_jax(self):
+        from turboprune_tpu.analysis.compile_audit import _runtime_name
+
+        assert _runtime_name("train_step") == "jit_train_step"
+        assert _runtime_name("<lambda>") == "jit__lambda_"
+        assert _runtime_name("_apply") == "jit__apply"
+
+    def test_unknown_target_is_usage_error(self):
+        from turboprune_tpu.analysis.compile_audit import (
+            AuditError,
+            run_compile_audit,
+        )
+
+        with pytest.raises(AuditError, match="bogus"):
+            run_compile_audit("bogus-target")
+
+    def test_ledger_attributes_by_name_and_site(self):
+        from turboprune_tpu.analysis.compile_audit import _attribution
+
+        spans = [("lib/engine.py", 10, 40, "entry _apply")]
+        names = {"_apply", "train_step"}
+        rec = {"name": "jit_train_step", "site": None}
+        assert "name match" in _attribution(rec, names, spans)
+        rec = {
+            "name": "jit_mystery",
+            "site": (str(REPO / "lib/engine.py"), 22),
+        }
+        assert "entry _apply" in _attribution(rec, names, spans)
+        rec = {
+            "name": "jit_mystery",
+            "site": (str(REPO / "lib/other.py"), 5),
+        }
+        assert _attribution(rec, names, spans) is None

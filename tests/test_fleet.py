@@ -375,6 +375,39 @@ class TestFleetEngine:
         finally:
             fresh.close()
 
+    def test_aot_keys_are_manifest_covered(self, fleet):
+        """Exec-manifest closure over the persistent cache: every *.aotx
+        the fleet wrote was minted through make_key (the key ledger — no
+        anonymous executables on disk), every ledger plan kind is one the
+        static manifest enumerates, every ledger bucket is one this fleet
+        declared, and the production bucket union itself is covers()-ed."""
+        from pathlib import Path
+
+        from turboprune_tpu.analysis.exec_manifest import (
+            build_manifest,
+            covers,
+        )
+
+        for model_id in ("level_0", "level_1", "level_2"):
+            fleet.predict(_images(20, 2), model=model_id, timeout=60)
+        manifest = build_manifest()
+        ledger = fleet.aot_cache.key_meta()
+        on_disk = {
+            p.stem for p in Path(fleet.aot_cache.dir).glob("*.aotx")
+        }
+        assert on_disk, "warm fleet should have persisted executables"
+        assert on_disk <= set(ledger), "key(s) on disk the ledger never minted"
+        kinds = {meta["plan_kind"] for meta in ledger.values()}
+        assert kinds == {"masked", "compact", "nm"}
+        assert kinds <= set(manifest["plan_kinds"])
+        assert {meta["bucket"] for meta in ledger.values()} <= set(BUCKETS)
+        # The production bucket set is covered end to end for every kind
+        # this fleet exercised (the test fleet's (2,) is a deliberate
+        # override; DEFAULT_BUCKETS is what ships).
+        for kind in kinds:
+            assert all(covers(manifest, kind, b) for b in manifest["buckets"])
+        assert not covers(manifest, "mystery-plan", manifest["buckets"][0])
+
     def test_lru_eviction_and_page_back_in(self, fleet_expt, fleet):
         """max_resident_models=2: third model evicts the least-recently-used
         one; paging back in works and the evicted model's metrics instance
@@ -533,6 +566,8 @@ class TestFleetHTTP:
         assert models["level_1"]["backend"] == "compact"
         assert models["level_2"]["backend"] == "nm"
         assert "aot_cache" in health
+        # the fleet-wide bucket surface is a first-class health field
+        assert health["buckets"] == list(BUCKETS)
 
     def test_metrics_endpoint_labels_by_model(self, fleet_server):
         status, body = _get(fleet_server, "/metrics")
@@ -570,6 +605,26 @@ class _FakeEngine:
 def _fake_images(seed, n):
     rng = np.random.default_rng(seed)
     return rng.standard_normal((n, 4, 4, 3)).astype(np.float32)
+
+
+class TestBucketSurface:
+    def test_batcher_bucket_sizes_is_replica_union(self, fleet):
+        """bucket_sizes() is the sorted union across replica engines and
+        tolerates engines with no bucket set (test doubles)."""
+
+        class _Bucketed(_FakeEngine):
+            def __init__(self, buckets):
+                super().__init__()
+                self.buckets = buckets
+
+        batcher = DynamicBatcher(
+            [_Bucketed((8, 2)), _Bucketed((2, 32)), _FakeEngine()]
+        )
+        try:
+            assert batcher.bucket_sizes() == [2, 8, 32]
+        finally:
+            batcher.close()
+        assert fleet.info()["buckets"] == list(BUCKETS)
 
 
 class TestGracefulDrain:

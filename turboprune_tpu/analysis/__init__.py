@@ -44,6 +44,7 @@ from .core import (  # noqa: F401
 from . import rules  # noqa: F401  (registers the rule set)
 from . import dtype_rules  # noqa: F401  (registers the dtype-flow rules)
 from . import concurrency_rules  # noqa: F401  (registers the thread rules)
+from . import shape_rules  # noqa: F401  (registers the shape-flow rules)
 from .conf_rules import CONF_RULES  # noqa: F401
 from .reporters import render_json, render_sarif, render_text  # noqa: F401
 

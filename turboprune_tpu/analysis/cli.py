@@ -6,7 +6,7 @@ Exit codes (the contract scripts/check.sh and CI build on):
   2 — usage / environment error (bad path, unknown rule in --select,
       git unavailable for --changed, jax unavailable for --jaxpr-audit)
 
-Four modes:
+Modes:
 
 * per-file (default) — the lexical rules over the given paths;
 * ``--project`` — per-file PLUS the interprocedural layer (symbol
@@ -28,7 +28,17 @@ Four modes:
   (sanitizer.py): wrap ``threading.Lock``/``RLock``/``Condition``, drive
   the PrefetchEngine / FleetEngine load smokes (or a ``file.py:builder``
   target), fail on observed lock-order cycles and on shared-attribute
-  races the static rules did not predict.
+  races the static rules did not predict;
+* ``--exec-manifest [emit|diff|print]`` — statically enumerate the
+  compile surface (jit entries, compile sites, bucket sets, plan kinds)
+  into analysis/exec_manifest.json; ``diff`` fails when the surface has
+  drifted from the checked-in manifest (exec_manifest.py);
+* ``--compile-audit [TARGET]`` — the runtime mirror of the manifest
+  (compile_audit.py): patch jax's backend_compile, drive the serving /
+  train smokes, and fail on any XLA compile the manifest does not
+  explain. Needs jax, like --jaxpr-audit;
+* ``--rule-docs`` — print the generated rule-catalog markdown table
+  (the source of README.md's marked block).
 
 With no paths it analyzes the installed ``turboprune_tpu`` package — the
 same invocation the self-gate test makes, so "the linter passes" means the
@@ -49,12 +59,18 @@ from .reporters import render_json, render_sarif, render_text
 
 _EPILOG = """\
 exit codes:
-  0  analyzed clean: zero unwaived findings (jaxpr audit: clean diff)
+  0  analyzed clean: zero unwaived findings (jaxpr audit: clean diff;
+     exec-manifest diff: no drift; compile audit: every compile
+     attributed)
   1  at least one unwaived finding (jaxpr audit: unexplained upcast or
      unwaived static dtype finding; sanitize: observed lock-order cycle
-     or a race with no static finding)
+     or a race with no static finding; exec-manifest diff: compile
+     surface drifted vs the checked-in manifest; compile audit: a
+     runtime XLA compile no manifest entry explains, or a compiled
+     (plan, bucket) outside the declared surface)
   2  usage or environment error (bad path, unknown rule in --select,
-     git unavailable for --changed, jax unavailable for --jaxpr-audit)
+     git unavailable for --changed, jax unavailable for
+     --jaxpr-audit/--compile-audit, missing manifest)
 """
 
 
@@ -135,6 +151,45 @@ def build_parser() -> argparse.ArgumentParser:
             "on shared-attribute races with no static "
             "unsynchronized-shared-mutation finding (a sanitizer-only "
             "race is a static blind spot)"
+        ),
+    )
+    p.add_argument(
+        "--exec-manifest",
+        nargs="?",
+        const="diff",
+        choices=("emit", "diff", "print"),
+        metavar="MODE",
+        help=(
+            "executable-set manifest (exec_manifest.py): statically "
+            "enumerate every jit entry, compile site, bucket set and "
+            "plan-signature kind; 'emit' writes "
+            "analysis/exec_manifest.json, 'diff' (default) rebuilds and "
+            "fails on drift vs the checked-in file, 'print' dumps the "
+            "fresh manifest"
+        ),
+    )
+    p.add_argument(
+        "--compile-audit",
+        nargs="?",
+        const="all",
+        metavar="TARGET",
+        help=(
+            "runtime mirror of the executable manifest "
+            "(compile_audit.py): patch jax's backend_compile, drive "
+            "TARGET ('serve', 'train', 'all', or 'file.py:builder' "
+            "returning a callable), and fail on any XLA compile not "
+            "attributed to a manifest entry/compile site, or any "
+            "compiled (plan, bucket) outside the declared surface "
+            "(needs jax)"
+        ),
+    )
+    p.add_argument(
+        "--rule-docs",
+        action="store_true",
+        help=(
+            "print the README rule-catalog markdown table generated from "
+            "the rule registries (the marked block in README.md must "
+            "match — tests/test_analysis.py gates it)"
         ),
     )
     p.add_argument(
@@ -247,6 +302,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             ("--changed", bool(args.changed)),
             ("--jaxpr-audit", bool(args.jaxpr_audit)),
             ("--sanitize", bool(args.sanitize)),
+            ("--exec-manifest", bool(args.exec_manifest)),
+            ("--compile-audit", bool(args.compile_audit)),
+            ("--rule-docs", args.rule_docs),
         )
         if on
     ]
@@ -289,6 +347,30 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             return run_sanitize(args.sanitize)
         except SanitizeError as e:
             print(f"graftlint --sanitize: {e}", file=sys.stderr)
+            return 2
+
+    if args.rule_docs:
+        from .reporters import render_rule_docs
+
+        print(render_rule_docs(), end="")
+        return 0
+
+    if args.exec_manifest:
+        from .exec_manifest import run_exec_manifest
+
+        try:
+            return run_exec_manifest(args.exec_manifest, paths=args.paths)
+        except ValueError as e:
+            print(f"graftlint --exec-manifest: {e}", file=sys.stderr)
+            return 2
+
+    if args.compile_audit:
+        from .compile_audit import AuditError, run_compile_audit
+
+        try:
+            return run_compile_audit(args.compile_audit)
+        except AuditError as e:
+            print(f"graftlint --compile-audit: {e}", file=sys.stderr)
             return 2
 
     try:

@@ -87,6 +87,10 @@ class UnsynchronizedSharedMutationRule(_ProjectConcurrencyRule):
         "with no common lock (or in violation of its # guarded-by: "
         "annotation)"
     )
+    doc_why = (
+        "torn and stale reads on the serving hot path — races that only "
+        "reproduce under production load, never in single-threaded tests"
+    )
 
 
 @register
@@ -96,6 +100,10 @@ class LockOrderInversionRule(_ProjectConcurrencyRule):
     description = (
         "cycle in the interprocedural lock-acquisition-order graph "
         "(opposite-order deadlock, or a non-reentrant self-acquire)"
+    )
+    doc_why = (
+        "two threads acquiring in opposite orders deadlock with no "
+        "traceback — requests hang until the process is killed"
     )
 
 
@@ -107,6 +115,11 @@ class BlockingCallUnderLockRule(_ProjectConcurrencyRule):
         "sleep/queue/socket/Future/AOT-compile blocking operation while "
         "holding a lock, directly or through a resolved callee"
     )
+    doc_why = (
+        "seconds of blocking work under a lock head-of-line-blocks every "
+        "thread behind it — one cold-bucket compile can stall the whole "
+        "serving fleet"
+    )
 
 
 @register
@@ -116,6 +129,11 @@ class CheckThenActRaceRule(_ProjectConcurrencyRule):
     description = (
         "unguarded 'if k not in self.d: self.d[k] = ...' in thread-aware "
         "code (both threads see 'missing', both insert)"
+    )
+    doc_why = (
+        "both threads see the missing state and both act — double "
+        "compiles, double closes, lost idempotence (the PrefetchEngine "
+        "close() bug class)"
     )
 
 
@@ -127,6 +145,11 @@ class CvWaitNoPredicateLoopRule(Rule):
     description = (
         "Condition.wait() whose innermost enclosing loop is not a while "
         "(spurious wakeup / stolen notification loses the signal)"
+    )
+    doc_why = (
+        "spurious wakeups and stolen signals are legal; an if-guarded "
+        "wait proceeds on a false predicate — the classic lost-wakeup "
+        "hang"
     )
 
     def check(self, ctx: ModuleContext) -> Iterator[Finding]:

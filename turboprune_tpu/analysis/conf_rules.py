@@ -59,6 +59,9 @@ class ConfRule:
     id: str
     severity: str
     description: str
+    # Why the hazard matters — the README catalog column `--rule-docs`
+    # generates, same contract as Rule.doc_why.
+    doc_why: str = ""
 
 
 CONF_RULES = {
@@ -69,36 +72,48 @@ CONF_RULES = {
             "error",
             "duplicate mapping key in a config yaml — pyyaml silently "
             "keeps the last one and the earlier value vanishes",
+            "the earlier value looks set in the file but never applies — "
+            "an invisible override",
         ),
         ConfRule(
             "conf-unknown-key",
             "error",
             "config key not present in the group's schema dataclass — "
             "the knob silently does nothing",
+            "the silent no-op config knob is this repo's original "
+            "root-cause bug class (see ISSUE history)",
         ),
         ConfRule(
             "conf-bad-choice",
             "error",
             "literal config value outside the field's declared choice set "
             "(PRUNE_METHODS, OPTIMIZERS, ...)",
+            "fails deep in the run (or never, with a fallback) instead "
+            "of at compose time",
         ),
         ConfRule(
             "conf-type-mismatch",
             "error",
             "yaml value whose type the schema field cannot coerce "
             "(per config/schema.py:_coerce semantics)",
+            "coercion surprises surface as shape/dtype errors far from "
+            "the yaml line that caused them",
         ),
         ConfRule(
             "conf-missing-group-file",
             "error",
             "defaults: entry pointing at a conf/<group>/<option>.yaml "
             "that does not exist",
+            "composition fails at runtime on a path typo that was "
+            "knowable statically",
         ),
         ConfRule(
             "conf-dead-schema-field",
             "warning",
             "schema dataclass field never read via attribute access by "
             "any code outside config/schema.py — dead config surface",
+            "a knob wired to nothing misleads every future reader into "
+            "tuning it",
         ),
     ]
 }

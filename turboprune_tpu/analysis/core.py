@@ -213,6 +213,9 @@ class Rule:
     # fires only from check_project; the per-file driver skips it and
     # per-file stale-waiver accounting treats its waivers as out of scope.
     project_only: bool = False
+    # Why the hazard matters on TPU — the third column of the README rule
+    # catalog, which `graftlint --rule-docs` generates from this registry.
+    doc_why: str = ""
 
     def check(self, ctx: ModuleContext) -> Iterator[Finding]:
         raise NotImplementedError
@@ -518,7 +521,7 @@ def _project_file_scan(args) -> tuple:
     (picklable) on purpose; the lazy imports re-register the rule set when
     the pool uses the spawn start method (fork inherits it)."""
     path, select = args
-    from . import concurrency_rules, dtype_rules, rules  # noqa: F401
+    from . import concurrency_rules, dtype_rules, rules, shape_rules  # noqa: F401
 
     p = Path(path)
     source = p.read_text(encoding="utf-8")

@@ -1,0 +1,127 @@
+"""Shared runtime-target resolution for graftlint's dynamic modes.
+
+Three modes mirror the static rules against a real run — ``--jaxpr-audit``
+(dtype rules vs the traced jaxpr), ``--sanitize`` (thread rules vs observed
+locks), and ``--compile-audit`` (shape rules / executable manifest vs the
+compiles XLA actually performs). Each accepts a target spec; before this
+module each reimplemented spec parsing with drifting semantics (the
+sanitizer accepted only ``file.py:builder`` while the jaxpr audit also took
+``pkg.module:builder``). Now all three resolve through one registry:
+
+* a mode-specific table of NAMED targets (``train``/``eval`` step entries,
+  the ``pipeline``/``fleet``/``serve`` load drivers);
+* ``path/to/file.py:builder`` — load the file, call ``builder()``;
+* ``pkg.module:builder`` — import the module, call ``builder()``.
+
+The shared synthetic train/eval step entry (tiny resnet18, CIFAR-shaped
+inputs, fixed PRNG key) also lives here: the jaxpr audit traces it and the
+compile audit jits it, so both gates measure the same program.
+
+jax imports stay inside functions — the analysis package must import with
+no accelerator stack; only the runtime modes pay for the tracer.
+"""
+
+from __future__ import annotations
+
+import importlib
+import importlib.util
+from pathlib import Path
+from typing import Optional
+
+__all__ = [
+    "TargetError",
+    "default_step_entry",
+    "load_builder",
+    "resolve_runtime_target",
+]
+
+
+class TargetError(RuntimeError):
+    """Bad target spec (CLI modes map their subclass to exit code 2)."""
+
+
+def load_builder(
+    spec: str, error_cls=TargetError, what: str = "target"
+) -> tuple:
+    """``(builder, static_paths)`` for a ``file.py:fn`` / ``pkg.module:fn``
+    spec. ``static_paths`` is the file list a mode's static half should
+    analyze alongside the runtime run (the defining file)."""
+    mod_part, sep, fn_name = spec.rpartition(":")
+    if not sep or not mod_part or not fn_name:
+        raise error_cls(
+            f"bad {what} {spec!r}: expected 'path/to/file.py:builder' or "
+            "'pkg.module:builder'"
+        )
+    if mod_part.endswith(".py"):
+        path = Path(mod_part)
+        if not path.is_file():
+            raise error_cls(f"{what}: no such file: {path}")
+        mod_spec = importlib.util.spec_from_file_location(path.stem, path)
+        mod = importlib.util.module_from_spec(mod_spec)
+        mod_spec.loader.exec_module(mod)
+        static_paths = [path]
+    else:
+        try:
+            mod = importlib.import_module(mod_part)
+        except ImportError as e:
+            raise error_cls(f"{what}: cannot import {mod_part!r}: {e}") from e
+        static_paths = [Path(mod.__file__)]
+    builder = getattr(mod, fn_name, None)
+    if builder is None:
+        raise error_cls(f"{what}: {mod_part} has no {fn_name!r}")
+    return builder, static_paths
+
+
+def resolve_runtime_target(
+    spec: str,
+    named: dict,
+    error_cls=TargetError,
+    what: str = "target",
+    load: bool = True,
+) -> tuple:
+    """``("named", named[spec])`` or ``("builder", (builder, paths))``.
+
+    ``named`` maps target names to mode-specific payloads (a driver
+    callable, an entry kind — whatever the mode keys on). Anything else
+    with a ``:`` resolves as a builder spec; anything else is a usage
+    error that lists the names, so every mode rejects typos the same way.
+
+    ``load=False`` defers the import: ``("builder", spec)`` comes back
+    after the grammar check only, for modes that must not execute the
+    target module until their instrumented window is open.
+    """
+    if spec in named:
+        return "named", named[spec]
+    if ":" in spec:
+        if not load:
+            return "builder", spec
+        return "builder", load_builder(spec, error_cls=error_cls, what=what)
+    raise error_cls(
+        f"unknown {what} {spec!r}; expected one of "
+        f"{', '.join(sorted(named))}, 'path/to/file.py:builder' or "
+        "'pkg.module:builder'"
+    )
+
+
+def default_step_entry(kind: str, policy: str = "fp32") -> tuple:
+    """``(step_fn, args)`` for the synthetic-task train/eval step: tiny
+    resnet18, CIFAR-shaped inputs. The jaxpr audit traces it, the compile
+    audit jits and runs it — one program, two mirrors."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..train import create_train_state, make_eval_step, make_train_step, sgd
+    from ..models import create_model
+
+    model = create_model("resnet18", num_classes=10, dataset_name="CIFAR10")
+    tx = sgd(0.1, momentum=0.9, weight_decay=5e-4)
+    state = create_train_state(
+        # graftlint: disable=rng-key-reuse -- fixed key: the audits are reproducible gates, not samplers
+        model, tx, jax.random.key(0), input_shape=(2, 8, 8, 3)
+    )
+    images = jnp.zeros((2, 8, 8, 3), jnp.float32)
+    if policy in ("bf16", "bfloat16"):
+        images = images.astype(jnp.bfloat16)
+    labels = jnp.zeros((2,), jnp.int32)
+    fn = make_train_step(model, tx) if kind == "train" else make_eval_step(model)
+    return fn, (state, (images, labels))

@@ -223,6 +223,11 @@ class SilentUpcastRule(Rule):
         "default-dtype mean/var/softmax accumulation) inside a "
         "reduced-precision jit region"
     )
+    doc_why = (
+        "each silent promotion quietly runs that op at fp32 — the bf16 "
+        "speedup evaporates one line at a time, with "
+        "bit-identical-looking code"
+    )
 
     def check(self, ctx: ModuleContext) -> Iterator:
         for region, _dt, why, sd in _reduced_regions(ctx):
@@ -264,6 +269,10 @@ class WeakTypePromotionRule(Rule):
         "at one site and a float literal at another — the weak scalar "
         "hardens to different dtypes across the jit boundary (silent "
         "recompile per flip)"
+    )
+    doc_why = (
+        "the weak scalar hardens to i32 vs f32 across the jit boundary — "
+        "a dtype flip and a silent recompile per flip"
     )
 
     def check(self, ctx: ModuleContext) -> Iterator:
@@ -359,6 +368,10 @@ class ScanCarryDtypeDriftRule(Rule):
     description = (
         "lax.scan carry-in dtype differs from the dtype the body returns "
         "for the carry slot (trace error or per-iteration re-promotion)"
+    )
+    doc_why = (
+        "XLA raises at trace time, or for weak drifts re-promotes every "
+        "iteration of the epoch-length scan"
     )
 
     def check(self, ctx: ModuleContext) -> Iterator:
@@ -469,6 +482,11 @@ class MissingPreferredElementTypeRule(Rule):
     description = (
         "matmul/conv call on reduced-precision operands without an "
         "explicit accumulation dtype (preferred_element_type)"
+    )
+    doc_why = (
+        "the MXU accumulates in f32 but truncates back per tile; the "
+        "repo idiom is preferred_element_type=jnp.float32 (see "
+        "ops/flash.py)"
     )
 
     def check(self, ctx: ModuleContext) -> Iterator:

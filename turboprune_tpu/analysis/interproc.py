@@ -288,6 +288,11 @@ def check_project(index: ProjectIndex, contexts: dict) -> Iterator:
 
     findings.extend(dtype_project_findings(graph, contexts))
 
+    # shape-flow through call chains (helpers reached from jit entries)
+    from .shape_rules import shape_project_findings
+
+    findings.extend(shape_project_findings(graph, contexts))
+
     # concurrency layer: thread model + locksets (project-only rules)
     from .concurrency_rules import concurrency_findings
 

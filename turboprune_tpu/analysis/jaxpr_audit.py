@@ -31,12 +31,11 @@ defines it.
 
 from __future__ import annotations
 
-import importlib
-import importlib.util
 from pathlib import Path
 from typing import Callable, Iterator, Optional
 
 from .core import analyze_paths
+from .drivers import default_step_entry, resolve_runtime_target
 
 __all__ = ["AuditError", "DTYPE_RULE_IDS", "run_audit"]
 
@@ -60,53 +59,21 @@ class AuditError(RuntimeError):
 # ------------------------------------------------------------- entries
 
 
-def _default_entry(kind: str, policy: str):
-    import jax
-    import jax.numpy as jnp
-
-    from ..models import create_model
-    from ..train import create_train_state, make_eval_step, make_train_step, sgd
-
-    model = create_model("resnet18", num_classes=10, dataset_name="CIFAR10")
-    tx = sgd(0.1, momentum=0.9, weight_decay=5e-4)
-    state = create_train_state(
-        # graftlint: disable=rng-key-reuse -- fixed key: the audit is a reproducible gate, not a sampler
-        model, tx, jax.random.key(0), input_shape=(2, 8, 8, 3)
-    )
-    images = jnp.zeros((2, 8, 8, 3), jnp.float32)
-    if policy in ("bf16", "bfloat16"):
-        images = images.astype(jnp.bfloat16)
-    labels = jnp.zeros((2,), jnp.int32)
-    fn = make_train_step(model, tx) if kind == "train" else make_eval_step(model)
-    return fn, (state, (images, labels))
-
-
 def _load_entry(entry: str, policy: str):
-    """``(step_fn, args, static_paths)`` for an entry spec."""
+    """``(step_fn, args, static_paths)`` for an entry spec. Named entries
+    and builder specs resolve through the shared registry (drivers.py), so
+    the three runtime modes accept identical target grammar."""
     pkg = Path(__file__).resolve().parents[1]
-    if entry in ("train", "eval"):
-        fn, args = _default_entry(entry, policy)
+    kind, payload = resolve_runtime_target(
+        entry,
+        {"train": "train", "eval": "eval"},
+        error_cls=AuditError,
+        what="--jaxpr-audit entry",
+    )
+    if kind == "named":
+        fn, args = default_step_entry(payload, policy)
         return fn, args, [pkg / "train", pkg / "ops"]
-    mod_part, sep, fn_name = entry.rpartition(":")
-    if not sep or not mod_part or not fn_name:
-        raise AuditError(
-            f"bad --jaxpr-audit entry {entry!r}: expected 'train', 'eval', "
-            "'path/to/file.py:builder' or 'pkg.module:builder'"
-        )
-    if mod_part.endswith(".py"):
-        path = Path(mod_part)
-        if not path.is_file():
-            raise AuditError(f"--jaxpr-audit: no such file: {path}")
-        spec = importlib.util.spec_from_file_location(path.stem, path)
-        mod = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(mod)
-        static_paths = [path]
-    else:
-        mod = importlib.import_module(mod_part)
-        static_paths = [Path(mod.__file__)]
-    builder = getattr(mod, fn_name, None)
-    if builder is None:
-        raise AuditError(f"--jaxpr-audit: {mod_part} has no {fn_name!r}")
+    builder, static_paths = payload
     fn, args = builder()
     return fn, args, static_paths
 

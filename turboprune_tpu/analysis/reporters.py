@@ -20,7 +20,7 @@ from collections import Counter
 
 from .core import RULES, AnalysisResult
 
-__all__ = ["render_text", "render_json", "render_sarif"]
+__all__ = ["render_rule_docs", "render_text", "render_json", "render_sarif"]
 
 # v2: findings gained "trace" (interprocedural call-path, null for
 # per-file findings) when --project mode landed.
@@ -53,6 +53,37 @@ def render_text(result: AnalysisResult, show_waived: bool = False) -> str:
         f"({n_waived} waived) in {result.files_analyzed} file(s)"
     )
     return "\n".join(lines)
+
+
+def _md_cell(text: str) -> str:
+    return " ".join(str(text).split()).replace("|", "\\|")
+
+
+def render_rule_docs() -> str:
+    """The README rule-catalog table, generated from the registries so the
+    docs can never drift from the code (``--rule-docs``; the self-gate in
+    tests/test_analysis.py diffs this against README.md's marked block).
+    Project-scope rules are tagged in the severity column; ``doc_why`` is
+    each rule's third-column rationale."""
+    from .conf_rules import CONF_RULES
+
+    lines = [
+        "| Rule | Severity | Catches | Why it matters on TPU |",
+        "|---|---|---|---|",
+    ]
+
+    def row(rule, project: bool) -> None:
+        sev = rule.severity + (" (project)" if project else "")
+        lines.append(
+            f"| `{rule.id}` | {sev} | {_md_cell(rule.description)} | "
+            f"{_md_cell(rule.doc_why)} |"
+        )
+
+    for rid in sorted(RULES):
+        row(RULES[rid], RULES[rid].project_only)
+    for rid in sorted(CONF_RULES):
+        row(CONF_RULES[rid], True)
+    return "\n".join(lines) + "\n"
 
 
 def render_json(result: AnalysisResult) -> str:

@@ -180,6 +180,10 @@ class JitHostSyncRule(Rule):
         "host-sync op (.item()/float()/np.array/jax.device_get) reachable "
         "inside jit/shard_map/lax.scan-traced code"
     )
+    doc_why = (
+        "a device->host sync in compiled code serializes the XLA pipeline "
+        '-- the scan-epoch "one program per epoch" property dies'
+    )
 
     _SYNC_METHODS = {"item", "tolist", "block_until_ready"}
     _NUMPY_ROOTS = {"np", "numpy", "onp"}
@@ -267,6 +271,10 @@ class RetraceHazardRule(Rule):
         "built-and-called inline — defeats the trace cache, recompiles "
         "per call"
     )
+    doc_why = (
+        "jit caches on the function object; each of these recompiles per "
+        "call (seconds of XLA compile per step)"
+    )
 
     def check(self, ctx: ModuleContext) -> Iterator:
         reported: set = set()
@@ -350,6 +358,10 @@ class StaticArgnamesMismatchRule(Rule):
     description = (
         "static_argnames/static_argnums referencing parameters absent "
         "from the jitted function's signature"
+    )
+    doc_why = (
+        "the typo'd argument silently stays traced -> recompile per "
+        "Python value, tracer errors far from the cause"
     )
 
     def check(self, ctx: ModuleContext) -> Iterator:
@@ -463,6 +475,11 @@ class RngKeyReuseRule(Rule):
     description = (
         "PRNG key consumed twice without split, or constant PRNGKey in "
         "library code"
+    )
+    doc_why = (
+        "reused keys give CORRELATED draws (augmentation, init, pruning "
+        "all quietly share randomness); constant keys pin every caller "
+        "to one stream"
     )
 
     def check(self, ctx: ModuleContext) -> Iterator:
@@ -716,6 +733,11 @@ class CollectiveOrderRule(Rule):
         "collective op inside a process_index()/is_primary()-conditional "
         "branch — not all hosts reach it; multihost deadlock"
     )
+    doc_why = (
+        "hosts that skip the branch never post the collective — the pod "
+        "deadlocks with no traceback (process_count() guards are uniform "
+        "and exempt)"
+    )
 
     _COLLECTIVES = _COLLECTIVE_TAILS
 
@@ -768,6 +790,10 @@ class DonatedArgReuseRule(Rule):
     description = (
         "argument read after being passed to a donate_argnums jit — the "
         "buffer was donated and may alias the output"
+    )
+    doc_why = (
+        "the buffer was aliased into the output; reads return garbage or "
+        "raise depending on backend"
     )
 
     def check(self, ctx: ModuleContext) -> Iterator:
@@ -953,6 +979,10 @@ class BroadExceptRule(Rule):
         "bare/Exception-wide except that neither logs, re-raises, nor "
         "records the suppressed error"
     )
+    doc_why = (
+        'silent degradation is how "the config knob did nothing" bugs '
+        "survive review"
+    )
 
     _BROAD = {"Exception", "BaseException"}
     _EVIDENCE_CALLS = {
@@ -1033,6 +1063,10 @@ class DebugInHotPathRule(Rule):
         "print/jax.debug.print/breakpoint inside jit-traced code — "
         "trace-time noise or a per-step host callback in the hot path"
     )
+    doc_why = (
+        "trace-time-only prints mislead; debug callbacks stall the "
+        "device every step"
+    )
 
     _DEBUG_TAILS = {"set_trace", "breakpoint"}
 
@@ -1100,6 +1134,11 @@ class UnhashableWidthOverridesRule(Rule):
         "Modules hash into the jit cache, so the dict detonates at first "
         "traced apply; normalize with tuple(sorted(d.items())) or go "
         "through create_model"
+    )
+    doc_why = (
+        "flax Modules hash into the jit trace cache; a dict-valued field "
+        "raises TypeError at the first traced apply, far from the "
+        "construction site"
     )
 
     # create_model normalizes a raw dict itself; the sparse plan/result

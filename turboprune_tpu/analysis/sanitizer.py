@@ -37,11 +37,12 @@ and is not counted.
 
 from __future__ import annotations
 
-import importlib.util
 import sys
 import threading
 import time
 from pathlib import Path
+
+from .drivers import load_builder, resolve_runtime_target
 
 __all__ = ["Graftsan", "SanitizeError", "run_sanitize"]
 
@@ -512,18 +513,14 @@ def _drive_fleet(san: Graftsan) -> None:
 
 
 def _custom_driver(spec: str):
-    path_str, _, builder_name = spec.partition(":")
+    """Resolution is deferred to drive time on purpose: run_sanitize pays
+    for the full static pass before driving, and a bad spec should not
+    error only after that wait in tests that probe it directly."""
 
     def drive(_san: Graftsan) -> None:
-        path = Path(path_str)
-        if not path.exists():
-            raise SanitizeError(f"{path_str} not found")
-        mod_spec = importlib.util.spec_from_file_location(path.stem, path)
-        module = importlib.util.module_from_spec(mod_spec)
-        mod_spec.loader.exec_module(module)
-        builder = getattr(module, builder_name, None)
-        if builder is None:
-            raise SanitizeError(f"{path_str} has no {builder_name}()")
+        builder, _paths = load_builder(
+            spec, error_cls=SanitizeError, what="--sanitize target"
+        )
         fn = builder()
         if callable(fn):
             fn()
@@ -546,18 +543,19 @@ def _static_keys() -> set:
 
 def run_sanitize(target: str) -> int:
     target = target or "all"
-    drivers = []
-    if target in ("pipeline", "all"):
-        drivers.append(("pipeline", _drive_pipeline))
-    if target in ("fleet", "all"):
-        drivers.append(("fleet", _drive_fleet))
-    if not drivers:
-        if ":" not in target:
-            raise SanitizeError(
-                f"unknown target {target!r}; expected 'pipeline', 'fleet', "
-                "'all', or 'file.py:builder'"
-            )
-        drivers.append((target, _custom_driver(target)))
+    if target == "all":
+        drivers = [("pipeline", _drive_pipeline), ("fleet", _drive_fleet)]
+    else:
+        kind, payload = resolve_runtime_target(
+            target,
+            {"pipeline": _drive_pipeline, "fleet": _drive_fleet},
+            error_cls=SanitizeError,
+            what="--sanitize target",
+            load=False,  # builder modules must load inside the patched window
+        )
+        drivers = [
+            (target, payload if kind == "named" else _custom_driver(target))
+        ]
 
     # Static pass FIRST (it forks a process pool; keep that outside the
     # patched window) — its mutation keys are the explanation set.

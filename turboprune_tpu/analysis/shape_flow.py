@@ -1,0 +1,867 @@
+"""Shape lattice + abstract interpretation for the shape-flow rules.
+
+Every executable this repo caches — AOT bucket executables, compact-train
+step bundles, N:M plan programs — is keyed, directly or indirectly, by
+input SHAPES. A dim that varies where the code assumed it was fixed is a
+recompile; a dim that collides where the code assumed it distinguished is
+a wrong executable served. This module gives the rules in shape_rules.py
+(and the exec_manifest/compile_audit pair) a static approximation of how
+shapes flow through a function:
+
+* a small shape lattice — a shape is a tuple of dims where each dim is a
+  known ``int``, a symbolic name (``"n"``, ``"x:0"``), or ``"?"``; a whole
+  shape may also be unknown-rank (``None``). :func:`join_shape` joins
+  pointwise (mismatched ranks collapse to unknown) and
+  :func:`broadcast_shapes` models numpy-style right-aligned broadcasting;
+* :class:`ScopeShapes`, a single-pass abstract interpreter over a function
+  body (same architecture as dtype_flow.ScopeDtypes: assignments flow,
+  branches join, loop bodies run twice). It tracks ``.shape``
+  destructuring (``b, h, w, c = x.shape`` mints symbolic dims and
+  back-propagates the learned rank onto ``x``), ``reshape(-1)`` with the
+  product folded when every dim is known, broadcasting joins on binary
+  ops, the axis ADDS of ``stack`` / ``expand_dims`` / ``x[None]`` /
+  single-operand ``jax.vmap(lambda ...)``, the axis CONCATS of
+  ``concatenate``/``hstack``/``vstack``, and ``lax.scan``'s carry-shape
+  contract (carry keeps the init's shape; stacked ys are honest ``?``).
+
+Dims carry provenance: a :class:`DimVal` remembers which array name it was
+derived from (``src``), so a rule can ask "does this branch condition
+depend on a dim of a TRACED param" without re-walking the expression.
+
+Everything here is stdlib ``ast`` — same no-jax-at-import contract as the
+rest of the package. The model is deliberately an approximation: ``?`` is
+the honest default, rules only fire on KNOWN disagreements, so precision
+errs toward silence, never toward false findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterable, Optional, Union
+
+from .regions import dotted_name
+
+__all__ = [
+    "DIM_UNKNOWN",
+    "ArrayVal",
+    "DimVal",
+    "ShapeTupleVal",
+    "dim_known",
+    "join_dim",
+    "join_shape",
+    "broadcast_shapes",
+    "shape_product",
+    "ScopeShapes",
+]
+
+# ------------------------------------------------------------------ lattice
+
+DIM_UNKNOWN = "?"
+
+Dim = Union[int, str]  # int = known; str = symbolic name or "?"
+
+
+def dim_known(d: Dim) -> bool:
+    return isinstance(d, int)
+
+
+def join_dim(a: Dim, b: Dim) -> Dim:
+    """Equal dims (same int, same symbol) survive a join; anything else
+    is ``?`` — two branches that disagree about a dim make it unknown."""
+    return a if a == b else DIM_UNKNOWN
+
+
+def join_shape(a: Optional[tuple], b: Optional[tuple]) -> Optional[tuple]:
+    """Pointwise join; unknown rank absorbs, mismatched ranks collapse."""
+    if a is None or b is None:
+        return None
+    if len(a) != len(b):
+        return None
+    return tuple(join_dim(x, y) for x, y in zip(a, b))
+
+
+def broadcast_shapes(a: Optional[tuple], b: Optional[tuple]) -> Optional[tuple]:
+    """numpy-style right-aligned broadcast of two shapes. A known-1 dim
+    yields to the other side; equal dims (int or symbol) pass through;
+    a known/symbolic disagreement is ``?`` (we approximate, never error)."""
+    if a is None or b is None:
+        return None
+    out = []
+    for i in range(max(len(a), len(b))):
+        x = a[len(a) - 1 - i] if i < len(a) else 1
+        y = b[len(b) - 1 - i] if i < len(b) else 1
+        if x == 1:
+            out.append(y)
+        elif y == 1:
+            out.append(x)
+        elif x == y:
+            out.append(x)
+        else:
+            out.append(DIM_UNKNOWN)
+    return tuple(reversed(out))
+
+
+def shape_product(shape: Optional[tuple]) -> Dim:
+    """Element count: known iff every dim is known (``reshape(-1)``)."""
+    if shape is None:
+        return DIM_UNKNOWN
+    n = 1
+    for d in shape:
+        if not dim_known(d):
+            return DIM_UNKNOWN
+        n *= d
+    return n
+
+
+# ------------------------------------------------------- abstract values
+
+
+@dataclasses.dataclass(frozen=True)
+class ArrayVal:
+    """An array with ``shape`` (tuple of dims, or None = unknown rank) and
+    ``src``, the name it was seeded/derived from (provenance for rules)."""
+
+    shape: Optional[tuple] = None
+    src: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class DimVal:
+    """A host integer that is (or is derived from) an array dimension.
+    ``src`` names the array it came from, None for plain literals."""
+
+    dim: Dim = DIM_UNKNOWN
+    src: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ShapeTupleVal:
+    """The value of ``x.shape`` itself: indexable, destructurable.
+    ``dims`` None means the rank is unknown (symbolic dims are minted per
+    index on demand)."""
+
+    dims: Optional[tuple] = None  # tuple of Dim
+    src: Optional[str] = None
+
+    def item(self, i: int) -> DimVal:
+        if self.dims is not None and -len(self.dims) <= i < len(self.dims):
+            return DimVal(self.dims[i], self.src)
+        sym = f"{self.src}:{i}" if self.src else DIM_UNKNOWN
+        return DimVal(sym, self.src)
+
+
+UNKNOWN = None  # absent knowledge: not an array, not a dim, nothing tracked
+
+
+def _dim_of(v) -> Dim:
+    if isinstance(v, DimVal):
+        return v.dim
+    return DIM_UNKNOWN
+
+
+def _src_of(*vals) -> Optional[str]:
+    for v in vals:
+        s = getattr(v, "src", None)
+        if s:
+            return s
+    return None
+
+
+# ------------------------------------------------- call-name recognition
+
+
+def _tail(name: Optional[str]) -> Optional[str]:
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+def _root(name: Optional[str]) -> Optional[str]:
+    return name.split(".", 1)[0] if name else None
+
+
+def _is_jnp(name: Optional[str]) -> bool:
+    if not name:
+        return False
+    return (
+        _root(name) in ("jnp", "np", "numpy", "onp", "nn")
+        or name.startswith("jax.numpy.")
+        or name.startswith("jax.nn.")
+    )
+
+
+def _is_lax(name: Optional[str]) -> bool:
+    return bool(name) and "lax" in name.split(".")
+
+
+_CREATION = {"zeros", "ones", "empty", "full"}
+_LIKE = {"zeros_like", "ones_like", "empty_like", "full_like"}
+_SHAPE_PASS = {
+    # elementwise / dtype-ish ops that keep the operand's shape
+    "exp", "log", "sqrt", "rsqrt", "tanh", "sin", "cos", "abs", "negative",
+    "square", "sign", "relu", "gelu", "sigmoid", "softmax", "log_softmax",
+    "clip", "astype", "asarray", "array", "stop_gradient", "nan_to_num",
+    "sort", "flip", "roll", "copy", "where",
+}
+_CONCAT = {"concatenate", "hstack", "vstack"}
+_AXIS_ADD = {"stack"}
+_RANK_CHANGERS = {
+    "reshape", "ravel", "flatten", "squeeze", "expand_dims",
+    "atleast_1d", "atleast_2d", "atleast_3d",
+}
+
+
+def _kw(call: ast.Call, name: str):
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _const_int(node) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        inner = _const_int(node.operand)
+        return -inner if inner is not None else None
+    return None
+
+
+# ------------------------------------------------- the abstract interpreter
+
+
+class ScopeShapes:
+    """One forward pass over a function (or module) body: every expression
+    node gets an abstract value in ``self.at`` (keyed by ``id(node)``), and
+    top-level ``return`` statements collect in ``self.returns``.
+
+    Seed with ``{param: ArrayVal(None, src=param)}`` to mark traced array
+    params; ``.shape`` access on them mints provenance-carrying DimVals.
+    Mirrors dtype_flow.ScopeDtypes: nested defs run with a copied env,
+    branches join, loop bodies run twice for loop-carried names.
+    """
+
+    def __init__(self, scope: Optional[ast.AST], seed: Optional[dict] = None):
+        self.at: dict = {}
+        self.returns: list = []  # (Return node, abstract value)
+        env = dict(seed or {})
+        if scope is None:
+            return
+        if isinstance(scope, ast.Module):
+            self._exec_block(scope.body, env, top=True)
+        elif isinstance(scope, ast.Lambda):
+            v = self._infer(scope.body, env)
+            self.returns.append((scope.body, v))
+        else:  # FunctionDef / AsyncFunctionDef
+            for p in self._params(scope):
+                env.setdefault(p, UNKNOWN)
+            self._exec_block(scope.body, env, top=True)
+
+    # ---------------------------------------------------------------- query
+
+    def value_of(self, node: ast.AST):
+        return self.at.get(id(node), UNKNOWN)
+
+    def shape_of(self, node: ast.AST) -> Optional[tuple]:
+        v = self.value_of(node)
+        return v.shape if isinstance(v, ArrayVal) else None
+
+    # -------------------------------------------------------------- helpers
+
+    @staticmethod
+    def _params(fn: ast.AST) -> list:
+        a = fn.args
+        return [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+
+    def _assign_target(self, target: ast.AST, val, env: dict) -> None:
+        if isinstance(target, ast.Name):
+            env[target.id] = val
+        elif isinstance(target, ast.Starred):
+            self._assign_target(target.value, UNKNOWN, env)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            items = None
+            if isinstance(val, ShapeTupleVal):
+                items = [val.item(i) for i in range(len(target.elts))]
+            for i, elt in enumerate(target.elts):
+                self._assign_target(elt, items[i] if items else UNKNOWN, env)
+        # attribute/subscript targets: no tracked binding
+
+    def _assign(self, target: ast.AST, value: ast.AST, env: dict) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)) and isinstance(
+            value, (ast.Tuple, ast.List)
+        ) and len(target.elts) == len(value.elts):
+            for t, v in zip(target.elts, value.elts):
+                self._assign(t, v, env)
+            return
+        v = self._infer(value, env)
+        # carry, ys = lax.scan(f, init, xs): the scan contract pins the
+        # carry to the init's shape across every step; the stacked ys are
+        # honestly unknown (their lead dim is the scan length).
+        if (
+            isinstance(target, (ast.Tuple, ast.List))
+            and len(target.elts) == 2
+            and isinstance(value, ast.Call)
+            and _tail(dotted_name(value.func)) == "scan"
+            and _is_lax(dotted_name(value.func))
+            and len(value.args) >= 2
+        ):
+            init_v = self.value_of(value.args[1])
+            self._assign_target(
+                target.elts[0],
+                init_v if isinstance(init_v, ArrayVal) else UNKNOWN,
+                env,
+            )
+            self._assign_target(target.elts[1], UNKNOWN, env)
+            return
+        # b, h, w, c = x.shape  on an unknown-rank x: we just LEARNED x's
+        # rank — mint symbolic dims named after the targets and
+        # back-propagate the shape onto x itself.
+        if (
+            isinstance(target, (ast.Tuple, ast.List))
+            and isinstance(v, ShapeTupleVal)
+            and v.dims is None
+            and isinstance(value, ast.Attribute)
+            and value.attr == "shape"
+            and isinstance(value.value, ast.Name)
+            and not any(isinstance(t, ast.Starred) for t in target.elts)
+        ):
+            arr_name = value.value.id
+            dims = tuple(
+                t.id if isinstance(t, ast.Name) and t.id != "_" else DIM_UNKNOWN
+                for t in target.elts
+            )
+            env[arr_name] = ArrayVal(dims, src=arr_name)
+            v = ShapeTupleVal(dims, src=arr_name)
+        self._assign_target(target, v, env)
+
+    # ----------------------------------------------------------- statements
+
+    def _exec_block(self, stmts: Iterable, env: dict, top: bool) -> None:
+        for stmt in stmts:
+            self._exec(stmt, env, top)
+
+    def _exec(self, stmt: ast.AST, env: dict, top: bool) -> None:
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                self._assign(t, stmt.value, env)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._assign(stmt.target, stmt.value, env)
+        elif isinstance(stmt, ast.AugAssign):
+            v = self._infer(stmt.value, env)
+            if isinstance(stmt.target, ast.Name):
+                cur = env.get(stmt.target.id, UNKNOWN)
+                env[stmt.target.id] = self._binop(stmt.op, cur, v)
+        elif isinstance(stmt, ast.Return):
+            v = self._infer(stmt.value, env) if stmt.value is not None else UNKNOWN
+            if top:
+                self.returns.append((stmt, v))
+        elif isinstance(stmt, ast.Expr):
+            self._infer(stmt.value, env)
+        elif isinstance(stmt, ast.If):
+            self._infer(stmt.test, env)
+            a, b = dict(env), dict(env)
+            self._exec_block(stmt.body, a, top)
+            self._exec_block(stmt.orelse, b, top)
+            for k in set(a) | set(b):
+                env[k] = self._join_vals(a.get(k, UNKNOWN), b.get(k, UNKNOWN))
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._infer(stmt.iter, env)
+            self._assign_target(stmt.target, UNKNOWN, env)
+            self._exec_block(stmt.body, env, top)
+            self._exec_block(stmt.body, env, top)
+            self._exec_block(stmt.orelse, env, top)
+        elif isinstance(stmt, ast.While):
+            self._infer(stmt.test, env)
+            self._exec_block(stmt.body, env, top)
+            self._exec_block(stmt.body, env, top)
+            self._exec_block(stmt.orelse, env, top)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._infer(item.context_expr, env)
+            self._exec_block(stmt.body, env, top)
+        elif isinstance(stmt, ast.Try):
+            self._exec_block(stmt.body, env, top)
+            for h in stmt.handlers:
+                self._exec_block(h.body, env, top)
+            self._exec_block(stmt.orelse, env, top)
+            self._exec_block(stmt.finalbody, env, top)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            inner = dict(env)
+            for p in self._params(stmt):
+                inner[p] = UNKNOWN
+            self._exec_block(stmt.body, inner, top=False)
+        # ClassDef / imports / pass / etc: nothing to track
+
+    @staticmethod
+    def _join_vals(a, b):
+        if isinstance(a, ArrayVal) and isinstance(b, ArrayVal):
+            return ArrayVal(join_shape(a.shape, b.shape), a.src if a.src == b.src else None)
+        if isinstance(a, DimVal) and isinstance(b, DimVal):
+            return DimVal(join_dim(a.dim, b.dim), a.src if a.src == b.src else None)
+        if type(a) is type(b) and a == b:
+            return a
+        return UNKNOWN
+
+    # ---------------------------------------------------------- expressions
+
+    def _infer(self, node: Optional[ast.AST], env: dict):
+        if node is None:
+            return UNKNOWN
+        v = self._infer_inner(node, env)
+        self.at[id(node)] = v
+        return v
+
+    def _infer_inner(self, node: ast.AST, env: dict):
+        if isinstance(node, ast.Constant):
+            if isinstance(node.value, int) and not isinstance(node.value, bool):
+                return DimVal(node.value)
+            return UNKNOWN
+        if isinstance(node, ast.Name):
+            return env.get(node.id, UNKNOWN)
+        if isinstance(node, ast.BinOp):
+            return self._binop(
+                node.op,
+                self._infer(node.left, env),
+                self._infer(node.right, env),
+            )
+        if isinstance(node, ast.UnaryOp):
+            v = self._infer(node.operand, env)
+            if isinstance(node.op, ast.USub) and isinstance(v, DimVal) and dim_known(v.dim):
+                return DimVal(-v.dim, v.src)
+            return v
+        if isinstance(node, ast.IfExp):
+            self._infer(node.test, env)
+            return self._join_vals(
+                self._infer(node.body, env), self._infer(node.orelse, env)
+            )
+        if isinstance(node, ast.Compare):
+            self._infer(node.left, env)
+            for c in node.comparators:
+                self._infer(c, env)
+            return UNKNOWN
+        if isinstance(node, ast.BoolOp):
+            for v in node.values:
+                self._infer(v, env)
+            return UNKNOWN
+        if isinstance(node, ast.Subscript):
+            return self._subscript(node, env)
+        if isinstance(node, ast.Attribute):
+            return self._attribute(node, env)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            items = tuple(self._infer(e, env) for e in node.elts)
+            # a literal tuple of dims doubles as a shape-tuple value
+            if items and all(isinstance(i, DimVal) for i in items):
+                return ShapeTupleVal(tuple(i.dim for i in items), _src_of(*items))
+            return UNKNOWN
+        if isinstance(node, ast.Call):
+            return self._infer_call(node, env)
+        if isinstance(node, ast.Lambda):
+            inner = dict(env)
+            for p in self._params(node):
+                inner[p] = UNKNOWN
+            self._infer(node.body, inner)
+            return UNKNOWN
+        if isinstance(node, ast.Starred):
+            return self._infer(node.value, env)
+        return UNKNOWN
+
+    @staticmethod
+    def _binop(op: ast.AST, a, b):
+        if isinstance(a, ArrayVal) or isinstance(b, ArrayVal):
+            # array (x) array broadcasts; array (x) scalar keeps the shape
+            sa = a.shape if isinstance(a, ArrayVal) else ()
+            sb = b.shape if isinstance(b, ArrayVal) else ()
+            if not isinstance(a, ArrayVal):
+                return ArrayVal(sb, getattr(b, "src", None))
+            if not isinstance(b, ArrayVal):
+                return ArrayVal(sa, a.src)
+            return ArrayVal(broadcast_shapes(sa, sb))
+        if isinstance(a, DimVal) and isinstance(b, DimVal):
+            if dim_known(a.dim) and dim_known(b.dim):
+                try:
+                    if isinstance(op, ast.Add):
+                        return DimVal(a.dim + b.dim, _src_of(a, b))
+                    if isinstance(op, ast.Sub):
+                        return DimVal(a.dim - b.dim, _src_of(a, b))
+                    if isinstance(op, ast.Mult):
+                        return DimVal(a.dim * b.dim, _src_of(a, b))
+                    if isinstance(op, ast.FloorDiv) and b.dim != 0:
+                        return DimVal(a.dim // b.dim, _src_of(a, b))
+                except (OverflowError, ValueError):  # pragma: no cover
+                    pass
+            return DimVal(DIM_UNKNOWN, _src_of(a, b))
+        if isinstance(a, DimVal) or isinstance(b, DimVal):
+            d = a if isinstance(a, DimVal) else b
+            return DimVal(DIM_UNKNOWN, d.src)
+        return UNKNOWN
+
+    def _subscript(self, node: ast.Subscript, env: dict):
+        recv = self._infer(node.value, env)
+        sl = node.slice
+        if isinstance(recv, ShapeTupleVal):
+            self._infer(sl, env)
+            i = _const_int(sl)
+            if i is not None:
+                return recv.item(i)
+            return DimVal(DIM_UNKNOWN, recv.src)
+        if isinstance(recv, ArrayVal):
+            return self._index_array(recv, sl, env)
+        self._infer(sl, env)
+        return UNKNOWN
+
+    def _index_array(self, arr: ArrayVal, sl: ast.AST, env: dict) -> ArrayVal:
+        """One indexing step on an array: int index drops the axis, a slice
+        rewrites it, ``None`` adds one, a tuple applies per-axis."""
+        parts = sl.elts if isinstance(sl, ast.Tuple) else [sl]
+        if arr.shape is None:
+            for p in parts:
+                self._infer(p, env)
+            return ArrayVal(None, arr.src)
+        dims = list(arr.shape)
+        out: list = []
+        pos = 0
+        for p in parts:
+            if isinstance(p, ast.Constant) and p.value is None:
+                out.append(1)
+                continue
+            if pos >= len(dims):
+                return ArrayVal(None, arr.src)
+            if isinstance(p, ast.Slice):
+                out.append(self._slice_dim(dims[pos], p, env))
+                pos += 1
+            else:
+                v = self._infer(p, env)
+                if isinstance(v, ArrayVal):  # fancy indexing: give up
+                    return ArrayVal(None, arr.src)
+                pos += 1  # int index: axis dropped
+        out.extend(dims[pos:])
+        return ArrayVal(tuple(out), arr.src)
+
+    def _slice_dim(self, dim: Dim, sl: ast.Slice, env: dict) -> Dim:
+        lo = self._infer(sl.lower, env) if sl.lower else None
+        hi = self._infer(sl.upper, env) if sl.upper else None
+        self._infer(sl.step, env)
+        if sl.step is not None:
+            return DIM_UNKNOWN
+        if sl.lower is None and sl.upper is None:
+            return dim
+        if sl.lower is None and isinstance(hi, DimVal):
+            # x[:k] — length k when k is known or symbolic (abstractly: the
+            # slice length IS the bound, assuming k <= dim)
+            return hi.dim
+        if sl.upper is None and isinstance(lo, DimVal) and dim_known(dim) and dim_known(lo.dim):
+            return max(dim - lo.dim, 0)
+        return DIM_UNKNOWN
+
+    def _attribute(self, node: ast.Attribute, env: dict):
+        recv = self._infer(node.value, env)
+        if isinstance(recv, ArrayVal):
+            if node.attr == "shape":
+                return ShapeTupleVal(recv.shape, recv.src)
+            if node.attr == "ndim":
+                if recv.shape is not None:
+                    return DimVal(len(recv.shape), recv.src)
+                return DimVal(DIM_UNKNOWN, recv.src)
+            if node.attr == "size":
+                return DimVal(shape_product(recv.shape), recv.src)
+            if node.attr == "T":
+                s = tuple(reversed(recv.shape)) if recv.shape is not None else None
+                return ArrayVal(s, recv.src)
+            if node.attr in ("real", "imag", "at"):
+                return recv
+        return UNKNOWN
+
+    # ------------------------------------------------------------- calls
+
+    def _reshape_result(self, call: ast.Call, base: ArrayVal, args: list, env: dict) -> ArrayVal:
+        """Target dims of ``reshape``: fold ``-1`` from the element count
+        when every other dim (and the source shape) is known."""
+        if len(args) == 1:
+            v = self._infer(args[0], env)
+            if isinstance(v, ShapeTupleVal) and v.dims is not None:
+                dims = list(v.dims)
+            elif isinstance(v, DimVal):
+                dims = [v.dim]
+            else:
+                return ArrayVal(None, base.src)
+        else:
+            dims = []
+            for a in args:
+                v = self._infer(a, env)
+                dims.append(v.dim if isinstance(v, DimVal) else DIM_UNKNOWN)
+        if -1 in dims:
+            total = shape_product(base.shape)
+            rest = 1
+            ok = dim_known(total)
+            for d in dims:
+                if d == -1:
+                    continue
+                if not dim_known(d):
+                    ok = False
+                    break
+                rest *= d
+            i = dims.index(-1)
+            dims[i] = (total // rest) if (ok and rest) else DIM_UNKNOWN
+        return ArrayVal(tuple(dims), base.src)
+
+    def _infer_call(self, node: ast.Call, env: dict):
+        f = node.func
+        name = dotted_name(f)
+        tail = _tail(name)
+
+        # method calls on a value we track: x.reshape(...), x.astype(...)
+        recv = UNKNOWN
+        if isinstance(f, ast.Attribute):
+            recv = self._infer(f.value, env)
+        if isinstance(recv, ArrayVal):
+            if f.attr in _RANK_CHANGERS:
+                for kw in node.keywords:
+                    self._infer(kw.value, env)
+                return self._method_rank_change(node, f.attr, recv, env)
+            if f.attr in ("astype", "copy", "clip", "sort", "block_until_ready"):
+                for a in node.args:
+                    self._infer(a, env)
+                for kw in node.keywords:
+                    self._infer(kw.value, env)
+                return recv
+            if f.attr in ("sum", "mean", "prod", "max", "min", "var", "std"):
+                for a in node.args:
+                    self._infer(a, env)
+                for kw in node.keywords:
+                    self._infer(kw.value, env)
+                if not node.args and not node.keywords:
+                    return ArrayVal(())
+                return ArrayVal(None, recv.src)
+
+        argv = [self._infer(a, env) for a in node.args]
+        for kw in node.keywords:
+            self._infer(kw.value, env)
+
+        if name == "len" and len(argv) == 1:
+            v = argv[0]
+            if isinstance(v, ArrayVal):
+                if v.shape is not None and v.shape:
+                    return DimVal(v.shape[0], v.src)
+                return DimVal(DIM_UNKNOWN, v.src)
+            if isinstance(v, ShapeTupleVal):
+                if v.dims is not None:
+                    return DimVal(len(v.dims), v.src)
+                return DimVal(DIM_UNKNOWN, v.src)
+            return UNKNOWN
+        if name == "int" and len(argv) == 1 and isinstance(argv[0], DimVal):
+            return argv[0]
+
+        # vmap adds a leading axis: jax.vmap(lambda v: body)(x)
+        if (
+            isinstance(f, ast.Call)
+            and _tail(dotted_name(f.func)) == "vmap"
+            and len(node.args) == 1
+            and isinstance(argv[0], ArrayVal)
+        ):
+            return self._vmap_result(f, argv[0], env)
+
+        if not _is_jnp(name) and not _is_lax(name):
+            if isinstance(f, ast.Attribute) and f.attr == "astype":
+                recv = self.value_of(f.value)
+                if isinstance(recv, ArrayVal):
+                    return recv
+            if tail == "scan" and _is_lax(name) and len(node.args) >= 2:
+                # carry keeps the init's shape (the scan contract); the
+                # stacked ys are honestly unknown
+                return UNKNOWN
+            return UNKNOWN
+
+        if tail == "scan" and len(argv) >= 2:
+            return UNKNOWN  # (carry, ys) tuple: callers read via unpacking
+        if tail in _CREATION:
+            shape_arg = node.args[0] if node.args else _kw(node, "shape")
+            if shape_arg is not None:
+                v = self.value_of(shape_arg) if id(shape_arg) in self.at else self._infer(shape_arg, env)
+                if isinstance(v, ShapeTupleVal) and v.dims is not None:
+                    return ArrayVal(v.dims)
+                if isinstance(v, DimVal):
+                    return ArrayVal((v.dim,))
+            return ArrayVal(None)
+        if tail in _LIKE and argv:
+            v = argv[0]
+            return v if isinstance(v, ArrayVal) else ArrayVal(None)
+        if tail == "arange" and argv:
+            v = argv[0]
+            if len(node.args) == 1 and isinstance(v, DimVal):
+                return ArrayVal((v.dim,), v.src)
+            return ArrayVal((DIM_UNKNOWN,))
+        if tail == "reshape" and node.args:
+            base = argv[0]
+            if isinstance(base, ArrayVal):
+                return self._reshape_result(node, base, node.args[1:], env)
+            return UNKNOWN
+        if tail == "expand_dims" and argv:
+            base = argv[0]
+            if isinstance(base, ArrayVal):
+                return self._expand_dims(base, node, env)
+            return UNKNOWN
+        if tail == "squeeze" and argv and isinstance(argv[0], ArrayVal):
+            return self._squeeze(argv[0], node)
+        if tail in ("ravel", "flatten") and argv and isinstance(argv[0], ArrayVal):
+            return ArrayVal((shape_product(argv[0].shape),), argv[0].src)
+        if tail in _CONCAT and node.args:
+            return self._concat(tail, node, env)
+        if tail in _AXIS_ADD and node.args:
+            return self._stack(node, env)
+        if tail == "broadcast_to" and len(node.args) >= 2:
+            v = self.value_of(node.args[1])
+            if isinstance(v, ShapeTupleVal) and v.dims is not None:
+                return ArrayVal(v.dims)
+            return ArrayVal(None)
+        if tail == "matmul" or tail == "dot":
+            a, b = (argv + [UNKNOWN, UNKNOWN])[:2]
+            if (
+                isinstance(a, ArrayVal) and isinstance(b, ArrayVal)
+                and a.shape is not None and b.shape is not None
+                and len(a.shape) == 2 and len(b.shape) == 2
+            ):
+                return ArrayVal((a.shape[0], b.shape[1]))
+            return ArrayVal(None)
+        if tail == "where" and len(argv) >= 3:
+            x, y = argv[1], argv[2]
+            if isinstance(x, ArrayVal) and isinstance(y, ArrayVal):
+                return ArrayVal(broadcast_shapes(x.shape, y.shape))
+            return argv[1] if isinstance(argv[1], ArrayVal) else UNKNOWN
+        if tail in _SHAPE_PASS and argv:
+            v = argv[0]
+            return v if isinstance(v, ArrayVal) else UNKNOWN
+        if tail in ("sum", "mean", "prod", "max", "min", "var", "std") and argv:
+            v = argv[0]
+            if isinstance(v, ArrayVal):
+                axis = _kw(node, "axis")
+                if axis is None and len(node.args) < 2:
+                    return ArrayVal(())  # full reduction: scalar
+                return ArrayVal(None, v.src)
+            return UNKNOWN
+        if tail == "pad" and argv and isinstance(argv[0], ArrayVal):
+            # padded dims are data-dependent on the pad widths: honest ?
+            s = argv[0].shape
+            return ArrayVal(tuple(DIM_UNKNOWN for _ in s) if s is not None else None, argv[0].src)
+        return UNKNOWN
+
+    def _method_rank_change(self, node: ast.Call, attr: str, recv: ArrayVal, env: dict):
+        if attr == "reshape":
+            return self._reshape_result(node, recv, node.args, env)
+        if attr in ("ravel", "flatten", "atleast_1d"):
+            for a in node.args:
+                self._infer(a, env)
+            return ArrayVal((shape_product(recv.shape),), recv.src)
+        if attr == "squeeze":
+            for a in node.args:
+                self._infer(a, env)
+            return self._squeeze(recv, node)
+        if attr == "expand_dims":
+            return self._expand_dims(recv, node, env)
+        for a in node.args:
+            self._infer(a, env)
+        return ArrayVal(None, recv.src)
+
+    def _expand_dims(self, base: ArrayVal, node: ast.Call, env: dict) -> ArrayVal:
+        axis_node = _kw(node, "axis")
+        if axis_node is None:
+            # positional: jnp.expand_dims(x, ax) or x.expand_dims(ax)
+            pos = node.args[1:] if self.value_of(node.args[0]) is base else node.args
+            axis_node = pos[0] if pos else None
+        ax = _const_int(axis_node) if axis_node is not None else None
+        if base.shape is None or ax is None:
+            return ArrayVal(None, base.src)
+        dims = list(base.shape)
+        if ax < 0:
+            ax += len(dims) + 1
+        if 0 <= ax <= len(dims):
+            dims.insert(ax, 1)
+            return ArrayVal(tuple(dims), base.src)
+        return ArrayVal(None, base.src)
+
+    @staticmethod
+    def _squeeze(base: ArrayVal, node: ast.Call) -> ArrayVal:
+        if base.shape is None:
+            return ArrayVal(None, base.src)
+        if any(not dim_known(d) for d in base.shape):
+            # can't prove which axes are 1
+            return ArrayVal(None, base.src)
+        return ArrayVal(tuple(d for d in base.shape if d != 1), base.src)
+
+    def _concat(self, tail: str, node: ast.Call, env: dict):
+        seq = node.args[0]
+        if not isinstance(seq, (ast.Tuple, ast.List)) or not seq.elts:
+            return ArrayVal(None)
+        vals = [self.value_of(e) for e in seq.elts]
+        if not all(isinstance(v, ArrayVal) for v in vals):
+            return ArrayVal(None)
+        shapes = [v.shape for v in vals]
+        if any(s is None for s in shapes):
+            return ArrayVal(None)
+        rank = len(shapes[0])
+        if any(len(s) != rank for s in shapes):
+            return ArrayVal(None)
+        axis_node = _kw(node, "axis")
+        if axis_node is None and len(node.args) >= 2:
+            axis_node = node.args[1]
+        ax = _const_int(axis_node) if axis_node is not None else 0
+        if tail == "vstack":
+            ax = 0
+        elif tail == "hstack":
+            ax = 0 if rank == 1 else 1
+        if ax is None:
+            return ArrayVal(None)
+        if ax < 0:
+            ax += rank
+        if not 0 <= ax < rank:
+            return ArrayVal(None)
+        out: list = []
+        for i in range(rank):
+            dims = [s[i] for s in shapes]
+            if i == ax:
+                if all(dim_known(d) for d in dims):
+                    out.append(sum(dims))
+                else:
+                    out.append(DIM_UNKNOWN)
+            else:
+                d = dims[0]
+                for other in dims[1:]:
+                    d = join_dim(d, other)
+                out.append(d)
+        return ArrayVal(tuple(out))
+
+    def _stack(self, node: ast.Call, env: dict):
+        seq = node.args[0]
+        if not isinstance(seq, (ast.Tuple, ast.List)) or not seq.elts:
+            return ArrayVal(None)
+        vals = [self.value_of(e) for e in seq.elts]
+        if not all(isinstance(v, ArrayVal) for v in vals):
+            return ArrayVal(None)
+        inner = vals[0].shape
+        for v in vals[1:]:
+            inner = join_shape(inner, v.shape)
+        if inner is None:
+            return ArrayVal(None)
+        return ArrayVal((len(vals), *inner))
+
+    def _vmap_result(self, vmap_call: ast.Call, operand: ArrayVal, env: dict):
+        """``jax.vmap(f)(x)``: the mapped axis is re-added in front of
+        whatever ``f`` returns for one slice. Resolvable only when ``f``
+        is a lambda (body inferable); else the lead dim alone is kept."""
+        lead = operand.shape[0] if operand.shape else DIM_UNKNOWN
+        fn = vmap_call.args[0] if vmap_call.args else None
+        if isinstance(fn, ast.Lambda):
+            params = self._params(fn)
+            inner_env = dict(env)
+            if params:
+                sliced = ArrayVal(
+                    operand.shape[1:] if operand.shape else None, params[0]
+                )
+                inner_env[params[0]] = sliced
+                for p in params[1:]:
+                    inner_env[p] = UNKNOWN
+            body = self._infer(fn.body, inner_env)
+            if isinstance(body, ArrayVal) and body.shape is not None:
+                return ArrayVal((lead, *body.shape))
+        if operand.shape is not None:
+            return ArrayVal(None)
+        return ArrayVal(None)

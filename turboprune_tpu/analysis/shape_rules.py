@@ -1,0 +1,690 @@
+"""Shape-flow rules: the static half of the executable-surface contract.
+
+Five rules over the lattice/interpreter in shape_flow.py, all tuned to the
+ways a shape can silently blow up (or silently collapse) the set of XLA
+executables this repo promises is finite:
+
+* ``shape-varying-jit-arg`` — a loop-varying or data-dependent dim reaches
+  a jitted callable with no pad/bucket site on the path: one compile per
+  distinct value, the classic recompile-per-iteration. Dims drawn from a
+  literal bucket table (``b = BUCKETS[i]``) are bounded and stay silent.
+* ``concrete-shape-branch`` — a Python ``if``/``while`` on a traced dim
+  inside a jit region. Legal (shapes are concrete at trace time) but each
+  shape class now traces a DIFFERENT program: the executable set fans out
+  per branch, invisibly to any bucket declaration.
+* ``bucket-set-escape`` — a bucket literal at an engine/batcher call site
+  that is not a member of the module's declared bucket set: the executable
+  it compiles exists outside every manifest, warmup loop, and pre-warm.
+* ``unpinned-donation-shape`` — a donated argument of a jitted callable
+  whose inferred shape differs across call sites: donation binds
+  per-executable, so every new shape is a new compile AND the buffer
+  reuse the donation promised silently stops happening.
+* ``rank-change-into-cache`` — a reshape/squeeze-produced array feeding a
+  keyed executable cache whose key uses a single dim (``x.shape[0]``)
+  without the rank: a (8,) and an (8, 1) collide on the same key and the
+  cache serves the wrong executable.
+
+In project mode ``concrete-shape-branch`` also fires through call chains:
+a helper reachable from a jit entry is analyzed with its params seeded as
+traced arrays, findings carrying the call path — same shape as
+dtype_rules.dtype_project_findings. All five rules skip test files (tests
+flex shapes on purpose) and only fire when the lattice KNOWS the hazard,
+so ``?`` stays silent rather than noisy.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Iterator, Optional
+
+from .core import ModuleContext, Rule, register
+from .regions import donation_spec, dotted_name, is_jit_wrapper, param_names
+from .shape_flow import (
+    ArrayVal,
+    DimVal,
+    ScopeShapes,
+    ShapeTupleVal,
+    dim_known,
+)
+
+__all__ = [
+    "ShapeVaryingJitArgRule",
+    "ConcreteShapeBranchRule",
+    "BucketSetEscapeRule",
+    "UnpinnedDonationShapeRule",
+    "RankChangeIntoCacheRule",
+    "shape_project_findings",
+]
+
+
+def _tail(name: Optional[str]) -> Optional[str]:
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+# ------------------------------------------------- shared: jitted callables
+
+
+def _module_jitted(ctx: ModuleContext) -> dict:
+    """Callable-name -> (positional params or None) for jitted callables
+    visible in this module: decorated defs plus ``g = jax.jit(f)``."""
+    jitted: dict = {}
+    defs = {
+        n.name: n
+        for n in ast.walk(ctx.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    for region in ctx.jit_regions:
+        node = region.node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ) and region.reason.startswith("@"):
+            jitted[node.name] = param_names(node)
+    for node in ast.walk(ctx.tree):
+        if (
+            isinstance(node, ast.Assign)
+            and isinstance(node.value, ast.Call)
+            and is_jit_wrapper(node.value.func)
+            and node.value.args
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+        ):
+            fn_arg = node.value.args[0]
+            params = None
+            if isinstance(fn_arg, ast.Name) and fn_arg.id in defs:
+                params = param_names(defs[fn_arg.id])
+            jitted[node.targets[0].id] = params
+    return jitted
+
+
+# --------------------------------------------------- shape-varying-jit-arg
+
+_PAD_SITE_MARKERS = ("pad", "bucket", "clamp")
+
+
+def _has_pad_site(expr: ast.AST) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            tail = _tail(dotted_name(node.func)) or ""
+            if any(m in tail.lower() for m in _PAD_SITE_MARKERS):
+                return True
+    return False
+
+
+def _names_in(expr: ast.AST) -> set:
+    return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+
+def _literal_int_seq(node: ast.AST) -> bool:
+    return isinstance(node, (ast.Tuple, ast.List)) and bool(node.elts) and all(
+        isinstance(e, ast.Constant) and isinstance(e.value, int)
+        for e in node.elts
+    )
+
+
+def _slice_varying(expr: ast.AST, varying: set) -> Optional[ast.AST]:
+    """First Subscript in ``expr`` whose slice bound references a varying
+    name — the syntactic site where a loop-varying dim is cut."""
+    for node in ast.walk(expr):
+        if not isinstance(node, ast.Subscript):
+            continue
+        parts = (
+            node.slice.elts if isinstance(node.slice, ast.Tuple) else [node.slice]
+        )
+        for p in parts:
+            if isinstance(p, ast.Slice):
+                for bound in (p.lower, p.upper, p.step):
+                    if bound is not None and _names_in(bound) & varying:
+                        return node
+    return None
+
+
+@register
+class ShapeVaryingJitArgRule(Rule):
+    id = "shape-varying-jit-arg"
+    severity = "warning"
+    skip_in_tests = True
+    description = (
+        "loop-varying or data-dependent dim reaches a jitted callable with "
+        "no pad/bucket site on the path — one XLA compile per distinct "
+        "value (recompile-per-iteration)"
+    )
+    doc_why = (
+        "A jit executable is specialized per shape: slicing `x[:n]` with a "
+        "loop-varying `n` compiles every iteration, turning a microseconds "
+        "dispatch into seconds of XLA work. Pad to a declared bucket "
+        "(serve/batcher.py) so the executable set stays finite."
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator:
+        jitted = _module_jitted(ctx)
+        if not jitted:
+            return
+        # literal int tables in scope: names whose subscript is a BOUNDED
+        # draw (b = BUCKETS[i] stays silent)
+        tables = {
+            t.id
+            for node in ast.walk(ctx.tree)
+            if isinstance(node, ast.Assign) and _literal_int_seq(node.value)
+            for t in node.targets
+            if isinstance(t, ast.Name)
+        }
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            yield from self._check_loop(ctx, loop, jitted, tables)
+
+    def _check_loop(
+        self, ctx: ModuleContext, loop: ast.AST, jitted: dict, tables: set
+    ) -> Iterator:
+        bindings: dict = {}  # name -> last RHS expr assigned in the loop body
+        for node in ast.walk(loop):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and isinstance(
+                node.targets[0], ast.Name
+            ):
+                bindings[node.targets[0].id] = node.value
+
+        varying: set = set()
+        if isinstance(loop, ast.For):
+            varying |= _names_in(loop.target)
+        else:
+            # while: loop-carried names (assigned from an expression that
+            # reads a name also assigned in the body)
+            assigned = set(bindings)
+            varying |= {
+                n for n, v in bindings.items() if _names_in(v) & assigned
+            }
+        for _ in range(2):  # fixpoint over intra-loop derivations
+            for name, value in bindings.items():
+                if name in varying or not (_names_in(value) & varying):
+                    continue
+                if _has_pad_site(value):
+                    continue  # padded/bucketed: bounded by construction
+                if (
+                    isinstance(value, ast.Subscript)
+                    and isinstance(value.value, ast.Name)
+                    and value.value.id in tables
+                ):
+                    continue  # drawn from a literal int table: bounded
+                varying.add(name)
+        if not varying:
+            return
+
+        for call in ast.walk(loop):
+            if not (
+                isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Name)
+                and call.func.id in jitted
+            ):
+                continue
+            args = list(call.args) + [kw.value for kw in call.keywords]
+            for arg in args:
+                expr = arg
+                if isinstance(arg, ast.Name) and arg.id in bindings:
+                    expr = bindings[arg.id]
+                if _has_pad_site(expr):
+                    continue
+                sub = _slice_varying(expr, varying)
+                if sub is None:
+                    continue
+                names = sorted(_names_in(sub) & varying) or sorted(varying)
+                yield ctx.finding(
+                    self,
+                    call,
+                    f"jitted {call.func.id}() receives an argument sliced "
+                    f"by loop-varying {', '.join(names)!s} — every distinct "
+                    "value is a fresh XLA compile; pad to a declared bucket "
+                    "(or draw the dim from a literal bucket table) so the "
+                    "executable set stays finite",
+                )
+                break
+
+
+# --------------------------------------------------- concrete-shape-branch
+
+
+def _branch_scan(
+    rule: Rule,
+    ctx: ModuleContext,
+    root: ast.AST,
+    sd: ScopeShapes,
+    traced: frozenset,
+    why: str,
+    trace_fn: Optional[Callable] = None,
+) -> Iterator:
+    for node in ast.walk(root):
+        if not isinstance(node, (ast.If, ast.While)):
+            continue
+        if isinstance(node, ast.If) and not node.orelse and all(
+            isinstance(s, ast.Raise) for s in node.body
+        ):
+            # a shape GUARD (body only raises) doesn't fan out the
+            # executable set: both classes fail at trace or run one program
+            continue
+        dep = _dim_dependency(node.test, sd, traced)
+        if dep is None:
+            continue
+        kind = "if" if isinstance(node, ast.If) else "while"
+        yield ctx.finding(
+            rule,
+            node,
+            f"Python `{kind}` on a dim of traced {dep!r} inside a jit "
+            f"region ({why}): each shape class traces a DIFFERENT program, "
+            "so the executable set fans out per branch, invisibly to any "
+            "bucket declaration; hoist the branch to the bucketing site or "
+            "use lax.cond on a traced value",
+            trace=trace_fn(node) if trace_fn else None,
+        )
+
+
+def _dim_dependency(
+    test: ast.AST, sd: ScopeShapes, traced: frozenset
+) -> Optional[str]:
+    """Name of the traced array whose dim the test depends on, if any."""
+    for node in ast.walk(test):
+        v = sd.value_of(node)
+        if isinstance(v, (DimVal, ShapeTupleVal)) and v.src in traced:
+            return v.src
+    return None
+
+
+@register
+class ConcreteShapeBranchRule(Rule):
+    id = "concrete-shape-branch"
+    severity = "warning"
+    skip_in_tests = True
+    description = (
+        "Python if/while on a traced dim inside a jit region — each shape "
+        "class traces a different program (executable fan-out per branch)"
+    )
+    doc_why = (
+        "Shapes are concrete at trace time, so the branch runs — but each "
+        "shape class now compiles a DIFFERENT executable, multiplying the "
+        "compile surface behind the bucket set's back. The manifest can "
+        "only bound what doesn't branch on shape inside jit."
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator:
+        for region in ctx.jit_regions:
+            traced = region.traced_params
+            if not traced:
+                continue
+            sd = ScopeShapes(
+                region.node, seed={p: ArrayVal(None, p) for p in traced}
+            )
+            yield from _branch_scan(
+                self, ctx, region.node, sd, traced, region.reason
+            )
+
+
+# ------------------------------------------------------- bucket-set-escape
+
+_BUCKET_CALL_TAILS = {"_executable", "warmup_bucket", "compile_bucket"}
+
+
+@register
+class BucketSetEscapeRule(Rule):
+    id = "bucket-set-escape"
+    severity = "error"
+    skip_in_tests = True
+    description = (
+        "bucket literal at an engine/cache call site that is not in the "
+        "module's declared bucket set — compiles an executable outside "
+        "every manifest and warmup"
+    )
+    doc_why = (
+        "Warmup, the AOT cache, blue/green pre-warm, and the exec manifest "
+        "all enumerate the DECLARED buckets; a stray literal compiles lazily "
+        "at first traffic instead — exactly the latency spike bucketing "
+        "exists to prevent."
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator:
+        declared: set = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and _literal_int_seq(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and "bucket" in t.id.lower():
+                        declared.update(e.value for e in node.value.elts)
+            elif isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    if kw.arg == "buckets" and _literal_int_seq(kw.value):
+                        declared.update(e.value for e in kw.value.elts)
+        if not declared:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            lit = None
+            for kw in node.keywords:
+                if kw.arg == "bucket" and isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, int):
+                    lit = kw.value.value
+            tail = _tail(dotted_name(node.func))
+            if (
+                lit is None
+                and tail in _BUCKET_CALL_TAILS
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, int)
+            ):
+                lit = node.args[0].value
+            if lit is not None and lit not in declared:
+                yield ctx.finding(
+                    self,
+                    node,
+                    f"bucket {lit} is not in this module's declared bucket "
+                    f"set {tuple(sorted(declared))} — the executable it "
+                    "compiles exists outside every manifest, warmup loop "
+                    "and pre-warm; add it to the declaration or draw from it",
+                )
+
+
+# ------------------------------------------------ unpinned-donation-shape
+
+
+def _donation_kwargs(call: ast.Call) -> Optional[tuple]:
+    """donation_spec without the jit-wrapper check on the callee — for
+    ``@partial(jax.jit, donate_argnums=...)`` where the outer call is
+    ``partial`` but the jit wrapper is its first argument."""
+    from .regions import literal_str_seq
+
+    nums, names = [], []
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            nums.extend(
+                e.value
+                for e in elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)
+            )
+        elif kw.arg == "donate_argnames":
+            names.extend(literal_str_seq(kw.value) or [])
+    return (tuple(nums), tuple(names)) if (nums or names) else None
+
+
+def _decorator_donation(node) -> Optional[tuple]:
+    for dec in getattr(node, "decorator_list", ()):
+        if not isinstance(dec, ast.Call):
+            continue
+        if is_jit_wrapper(dec.func):
+            spec = donation_spec(dec)
+            if spec is not None:
+                return spec
+        elif dec.args and is_jit_wrapper(dec.args[0]):
+            spec = _donation_kwargs(dec)
+            if spec is not None:
+                return spec
+    return None
+
+
+@register
+class UnpinnedDonationShapeRule(Rule):
+    id = "unpinned-donation-shape"
+    severity = "warning"
+    skip_in_tests = True
+    description = (
+        "donated arg of a jitted callable gets different known shapes at "
+        "different call sites — each shape is a fresh executable and the "
+        "donation silently stops holding"
+    )
+    doc_why = (
+        "Donation binds buffers per-executable. A donated arg whose shape "
+        "varies across call sites recompiles per shape AND quietly loses "
+        "the in-place buffer reuse the donation promised — double memory "
+        "at exactly the sites that opted into saving it."
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator:
+        defs = {
+            n.name: n
+            for n in ast.walk(ctx.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        donating: dict = {}  # callable name -> (params, donated positions)
+        for name, fn in defs.items():
+            spec = _decorator_donation(fn)
+            if spec is not None:
+                argnums, argnames = spec
+                params = param_names(fn)
+                slots = set(argnums) | {
+                    params.index(a) for a in argnames if a in params
+                }
+                if slots:
+                    donating[name] = (params, slots)
+        for node in ast.walk(ctx.tree):
+            if (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and is_jit_wrapper(node.value.func)
+                and node.value.args
+                and isinstance(node.value.args[0], ast.Name)
+                and node.value.args[0].id in defs
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                spec = donation_spec(node.value)
+                if spec is None:
+                    continue
+                argnums, argnames = spec
+                params = param_names(defs[node.value.args[0].id])
+                slots = set(argnums) | {
+                    params.index(a) for a in argnames if a in params
+                }
+                if slots:
+                    donating[node.targets[0].id] = (params, slots)
+        if not donating:
+            return
+
+        sd = ScopeShapes(ctx.tree)
+        sites: dict = {}  # (callable, slot) -> {shape: first call node}
+        for node in ast.walk(ctx.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in donating
+            ):
+                continue
+            params, slots = donating[node.func.id]
+            for i, arg in enumerate(node.args):
+                if i not in slots:
+                    continue
+                v = sd.value_of(arg)
+                if not (
+                    isinstance(v, ArrayVal)
+                    and v.shape is not None
+                    and all(dim_known(d) for d in v.shape)
+                ):
+                    continue
+                sites.setdefault((node.func.id, i), {}).setdefault(
+                    v.shape, node
+                )
+        for (fname, slot), by_shape in sites.items():
+            if len(by_shape) < 2:
+                continue
+            nodes = sorted(by_shape.items(), key=lambda kv: kv[1].lineno)
+            (s0, first), (s1, second) = nodes[0], nodes[1]
+            yield ctx.finding(
+                self,
+                second,
+                f"donated arg {slot} of jitted {fname}() is {s0} at line "
+                f"{first.lineno} but {s1} here — each distinct shape is a "
+                "fresh executable and the donation no longer reuses the "
+                "buffer; pin the shape (pad/bucket) or drop the donation",
+            )
+
+
+# ------------------------------------------------ rank-change-into-cache
+
+_RANK_CHANGE_TAILS = {
+    "reshape", "squeeze", "expand_dims", "ravel", "flatten",
+    "atleast_1d", "atleast_2d", "atleast_3d",
+}
+_CACHE_NAME_MARKERS = ("cache", "compiled", "executable")
+
+
+def _is_cache_name(name: Optional[str]) -> bool:
+    return bool(name) and any(m in name.lower() for m in _CACHE_NAME_MARKERS)
+
+
+def _dim_only_key_names(key: ast.AST, rank_changed: set) -> set:
+    """Rank-changed names whose SINGLE dim keys the expression, with no
+    rank witness (whole ``.shape``, ``.ndim``, ``len()``) beside it."""
+    dim_names: set = set()
+    rank_witness = False
+    subscripted_shapes: set = set()
+    for node in ast.walk(key):
+        if isinstance(node, ast.Subscript) and isinstance(
+            node.value, ast.Attribute
+        ) and node.value.attr == "shape" and isinstance(
+            node.value.value, ast.Name
+        ):
+            subscripted_shapes.add(id(node.value))
+            if node.value.value.id in rank_changed:
+                dim_names.add(node.value.value.id)
+    for node in ast.walk(key):
+        if isinstance(node, ast.Attribute):
+            if node.attr == "ndim":
+                rank_witness = True
+            elif node.attr == "shape" and id(node) not in subscripted_shapes:
+                rank_witness = True  # whole shape tuple in the key
+        elif isinstance(node, ast.Call) and dotted_name(node.func) == "len":
+            rank_witness = True
+    return set() if rank_witness else dim_names
+
+
+@register
+class RankChangeIntoCacheRule(Rule):
+    id = "rank-change-into-cache"
+    severity = "warning"
+    skip_in_tests = True
+    description = (
+        "reshape/squeeze-produced array keys an executable cache by a "
+        "single dim without the rank — different-rank arrays collide on "
+        "one key and the wrong executable is served"
+    )
+    doc_why = (
+        "An (8,) and an (8, 1) agree on shape[0] but compile different "
+        "programs; keyed only by the dim, the second lookup silently "
+        "returns the first's executable. Key by the full shape tuple (as "
+        "serve/fleet/aot_cache.py does) or include the rank."
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator:
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            rank_changed: set = set()
+            for node in ast.walk(fn):
+                if (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Call)
+                ):
+                    tail = _tail(dotted_name(node.value.func))
+                    if tail in _RANK_CHANGE_TAILS:
+                        rank_changed.add(node.targets[0].id)
+            if not rank_changed:
+                continue
+            for node in ast.walk(fn):
+                key = None
+                if isinstance(node, ast.Subscript) and _is_cache_name(
+                    dotted_name(node.value)
+                ):
+                    key = node.slice
+                elif isinstance(node, ast.Call) and _tail(
+                    dotted_name(node.func)
+                ) == "make_key":
+                    parts = list(node.args) + [kw.value for kw in node.keywords]
+                    key = ast.Tuple(elts=parts, ctx=ast.Load()) if parts else None
+                if key is None:
+                    continue
+                hits = _dim_only_key_names(key, rank_changed)
+                if hits:
+                    name = sorted(hits)[0]
+                    yield ctx.finding(
+                        self,
+                        node,
+                        f"executable cache keyed by a single dim of "
+                        f"{name!r}, which was rank-changed above — arrays "
+                        "of different rank with the same dim collide on "
+                        "this key and the wrong executable is served; key "
+                        "by the full shape tuple (or include the rank)",
+                    )
+
+
+# ------------------------------------------------------- project layer
+
+
+def shape_project_findings(graph, contexts: dict) -> Iterator:
+    """concrete-shape-branch through call chains: a helper reachable from
+    any jit entry is analyzed with its params seeded as traced arrays (the
+    entry passes its traced values on), findings carrying the call path.
+    Helpers that are themselves lexical regions are the per-file pass's
+    job and are skipped, mirroring dtype_rules.dtype_project_findings."""
+    from .callgraph import MAX_DEPTH, _fmt
+    from .core import RULES
+
+    rule = RULES["concrete-shape-branch"]
+
+    lexical_nodes = {
+        id(r.node)
+        for regions in graph.regions_by_module.values()
+        for r in regions
+    }
+    entries: list = []
+    for regions in graph.regions_by_module.values():
+        for region in regions:
+            fi = graph.index.function_for_node(region.node)
+            if fi is not None:
+                entries.append((fi, region.reason))
+
+    reach: dict = {}  # qualname -> (why, trace hops)
+    frontier = []
+    for fi, reason in entries:
+        if fi.qualname not in reach:
+            reach[fi.qualname] = (
+                reason,
+                [f"jit entry {_fmt(fi)} [{reason}]"],
+            )
+            frontier.append(fi)
+    depth = 0
+    while frontier and depth < MAX_DEPTH:
+        depth += 1
+        nxt = []
+        for fi in frontier:
+            why, trace = reach[fi.qualname]
+            for callee, line in graph.edges.get(fi.qualname, ()):
+                if callee.qualname in reach:
+                    continue
+                reach[callee.qualname] = (
+                    why,
+                    trace + [f"{_fmt(callee)} called at line {line}"],
+                )
+                nxt.append(callee)
+        frontier = nxt
+
+    entry_quals = {fi.qualname for fi, _ in entries}
+    for qual, (why, trace) in reach.items():
+        if qual in entry_quals:
+            continue
+        fi = graph.index.functions.get(qual)
+        if fi is None or id(fi.node) in lexical_nodes:
+            continue
+        ctx = contexts.get(fi.path)
+        if ctx is None:
+            continue
+        traced = frozenset(p for p in fi.params if p != "self")
+        if not traced:
+            continue
+        sd = ScopeShapes(
+            fi.node, seed={p: ArrayVal(None, p) for p in traced}
+        )
+
+        def trace_fn(node, _fi=fi, _trace=trace):
+            return _trace + [f"{_fi.name} ({_fi.path}:{node.lineno})"]
+
+        yield from _branch_scan(
+            rule, ctx, fi.node, sd, traced, f"{why}, via caller", trace_fn
+        )

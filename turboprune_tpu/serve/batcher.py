@@ -157,6 +157,16 @@ class DynamicBatcher:
     def queue_depth(self) -> int:
         return self._queue.qsize()
 
+    def bucket_sizes(self) -> list[int]:
+        """Sorted union of the padding buckets across every replica engine
+        — the executable set this batcher can route into (what /healthz
+        reports and the exec manifest must cover). getattr-tolerant so a
+        bare-callable test double (no ``buckets``) contributes nothing."""
+        out: set = set()
+        for eng in self._engines:
+            out.update(int(b) for b in getattr(eng, "buckets", ()))
+        return sorted(out)
+
     @property
     def outstanding(self) -> int:
         """Accepted-but-unanswered requests (queued + mid-flush)."""

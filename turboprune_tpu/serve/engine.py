@@ -49,6 +49,13 @@ from ..utils.checkpoint import ExperimentCheckpoints, restore_model_tree
 
 DEFAULT_BUCKETS = (1, 8, 32, 128)
 
+# Executable-surface hook: the plan-signature kind for the dense fallback
+# (no sparse plan). The sparse kinds live next to their plan dataclasses
+# (sparse/compact.py, sparse/nm_execute.py); analysis/exec_manifest.py
+# enumerates every PLAN_SIGNATURE_KIND declaration to bound the set of
+# plan formats an AOT cache key can carry.
+PLAN_SIGNATURE_KIND = "masked"
+
 
 def _clone_factory(model):
     """Default model re-instantiation for compact/nm backends: clone the
@@ -127,10 +134,7 @@ class InferenceEngine:
                 self._variables["batch_stats"] = result.batch_stats
             if metrics:
                 metrics.record_compaction(result.report)
-            self._plan_signature = (
-                "compact",
-                tuple(sorted(dict(result.width_overrides).items())),
-            )
+            self._plan_signature = result.plan_signature()
         elif backend == "nm":
             # Gathered N:M execution (sparse/nm_execute.py): fold masks
             # first — NM modules read raw kernel rows, so the folded params
@@ -151,10 +155,10 @@ class InferenceEngine:
                 }
                 if metrics:
                     metrics.record_nm(self.nm_plan_report)
-                self._plan_signature = ("nm", plan.as_override_tuple())
+                self._plan_signature = plan.plan_signature()
             else:
                 self.backend = "masked"
-                self._plan_signature = ("masked",)
+                self._plan_signature = (PLAN_SIGNATURE_KIND,)
             self._variables = {"params": folded}
             if batch_stats:
                 self._variables["batch_stats"] = batch_stats
@@ -166,7 +170,7 @@ class InferenceEngine:
             self._variables = {"params": folded}
             if batch_stats:
                 self._variables["batch_stats"] = batch_stats
-            self._plan_signature = ("masked",)
+            self._plan_signature = (PLAN_SIGNATURE_KIND,)
         self.num_classes = None  # set by the first compile (output aval)
         self._compiled: dict[int, Any] = {}
         self._compile_lock = threading.Lock()

@@ -67,6 +67,11 @@ class AOTExecutableCache:
         self._lock = threading.Lock()
         # guarded-by: _lock
         self._counters = {HIT: 0, MISS: 0, BYPASS: 0, CORRUPT: 0, "stores": 0}
+        # guarded-by: _lock. Ledger of every key minted this process:
+        # key -> {"plan_kind", "bucket"}. The audit surface for the exec
+        # manifest — tests assert each on-disk *.aotx key traces back to a
+        # (plan kind, bucket) pair the static manifest covers.
+        self._key_meta: dict = {}
 
     # --------------------------------------------------------------- keying
     @staticmethod
@@ -89,7 +94,21 @@ class AOTExecutableCache:
             },
             sort_keys=True,
         )
-        return hashlib.sha256(blob.encode()).hexdigest()[:40]
+        key = hashlib.sha256(blob.encode()).hexdigest()[:40]
+        kind = (
+            str(plan_signature[0])
+            if isinstance(plan_signature, (tuple, list)) and plan_signature
+            else repr(plan_signature)
+        )
+        with self._lock:
+            self._key_meta[key] = {"plan_kind": kind, "bucket": int(bucket)}
+        return key
+
+    def key_meta(self) -> dict:
+        """Snapshot of the key ledger: key -> {plan_kind, bucket} for every
+        key minted via make_key this process."""
+        with self._lock:
+            return {k: dict(v) for k, v in self._key_meta.items()}
 
     def _path(self, key: str) -> Path:
         return self.dir / f"{key}{_SUFFIX}"

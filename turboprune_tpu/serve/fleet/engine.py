@@ -227,6 +227,10 @@ class FleetEngine:
             "default_model": self.default_model,
             "max_resident_models": self.max_resident_models,
             "resident_models": len(residents),
+            # The fleet-wide bucket set every resident is built with — the
+            # executable surface per (model, plan): what /healthz reports
+            # and the exec manifest bounds.
+            "buckets": list(self.buckets),
             "models": models,
         }
         if self.aot_cache is not None:

@@ -209,6 +209,9 @@ class InferenceServer(ThreadingHTTPServer):
             "status": "ok",
             "queue_depth": self.batcher.queue_depth,
             **self.engine.info(),
+            # Union across replicas (supersedes the primary engine's own
+            # list): the full bucket surface this server can compile.
+            "buckets": self.batcher.bucket_sizes(),
         }
 
     def start_background(self) -> "InferenceServer":

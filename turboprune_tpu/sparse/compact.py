@@ -47,6 +47,12 @@ from .graph import CompactionError, PathT, PropagationGraph, _tree_get
 
 _ERF = np.vectorize(math.erf)
 
+# Executable-surface hook: the plan-signature KIND this module's results
+# contribute to AOT cache keys. analysis/exec_manifest.py enumerates these
+# statically (one declaration per plan format) so the manifest and the
+# serving engine agree on the signature vocabulary.
+PLAN_SIGNATURE_KIND = "compact"
+
 
 @dataclass
 class CompactionResult:
@@ -58,6 +64,11 @@ class CompactionResult:
     def as_override_tuple(self) -> tuple:
         """Hashable form for flax Module fields / cache keys."""
         return tuple(sorted(self.width_overrides.items()))
+
+    def plan_signature(self) -> tuple:
+        """(kind, widths) executable-cache signature: the plan component of
+        the serving engine's AOT key (serve/fleet/aot_cache.py make_key)."""
+        return (PLAN_SIGNATURE_KIND, self.as_override_tuple())
 
 
 @dataclass

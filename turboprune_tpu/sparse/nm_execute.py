@@ -54,6 +54,11 @@ from .nm import _matrix_view, eligible_layers
 # (N/M <= 1/2); dense level-0 masks never route.
 MIN_AXIS_SAVINGS = 0.25
 
+# Executable-surface hook: plan-signature kind for gathered N:M execution.
+# analysis/exec_manifest.py enumerates every PLAN_SIGNATURE_KIND declaration
+# in the package to bound the plan-format vocabulary of AOT cache keys.
+PLAN_SIGNATURE_KIND = "nm"
+
 
 # ------------------------------------------------------------- the matmul
 
@@ -287,6 +292,11 @@ class NMExecPlan:
     def as_override_tuple(self) -> tuple:
         """Hashable form for step-cache keys and Module metadata."""
         return tuple(sorted(self.overrides.items()))
+
+    def plan_signature(self) -> tuple:
+        """(kind, overrides) executable-cache signature: the plan component
+        of the serving engine's AOT key (serve/fleet/aot_cache.py)."""
+        return (PLAN_SIGNATURE_KIND, self.as_override_tuple())
 
 
 def _hook_key(model, name: str, shape: tuple) -> Optional[str]:
