@@ -43,6 +43,17 @@ numbers VERDICT r3/r4 asked for:
                            coverage (unrouted eligible layers listed),
                            forward parity max-abs-diff, and the zero
                            steady-state-recompile count
+  mixed_plan_*             one-planner backend mix (sparse/plan.py): a
+                           heterogeneous-mask VGG (dead conv channels +
+                           scattered in-axis 2:4 fc stack) timed as a
+                           train step under masked-dense / compact-only /
+                           nm-only / MIXED — every variant produced by
+                           plan_execution with forced modes; carries the
+                           per-layer decision table (backend + reason +
+                           cost-model est_gain), forward/grad parity vs
+                           masked-dense, per-variant steady-state
+                           recompiles, and mixed-vs-best-single-backend;
+                           CPU-pinned subprocess
   serving_load_*           fleet serving under OPEN-LOOP Poisson load
                            (serve/fleet/ + serve/loadgen.py): closed-loop
                            capacity, p50/p99/p99.9 + goodput + sheds per
@@ -513,10 +524,13 @@ def _tree_leaf(tree, path):
     return tree
 
 
-def _channel_structured_masks(params, graph, kill_frac: float):
+def _channel_structured_masks(params, graph, kill_frac: float, spaces=None):
     """Kill the kill_frac smallest-L2 fan-out slices of every compactable
     space; everything else stays dense. The channel structure compaction
-    needs — scattered unstructured zeros would compact to nothing."""
+    needs — scattered unstructured zeros would compact to nothing.
+    ``spaces``: optional name predicate restricting which spaces are killed
+    (the mixed_plan stage kills only conv spaces, leaving the fc stack to
+    the gathered path)."""
     from turboprune_tpu.ops import masking
 
     masks = jax.tree.map(
@@ -524,7 +538,9 @@ def _channel_structured_masks(params, graph, kill_frac: float):
         masking.make_masks(params),
         is_leaf=lambda v: v is None,
     )
-    for sp in graph.spaces.values():
+    for name, sp in graph.spaces.items():
+        if spaces is not None and not spaces(name):
+            continue
         node = masks
         for k in sp.producer.kernel[:-1]:
             node = node[k]
@@ -979,6 +995,230 @@ def bench_nm_frontier() -> dict:
         tag = f"nm_frontier_r18head_{pat.replace(':', '_')}"
         fields[f"{tag}_ms"] = round(hn_t * 1e3, 3)
         fields[f"{tag}_speedup_vs_masked_dense"] = round(hd_t / hn_t, 3)
+    return fields
+
+
+# ------------------------------------------------------------- mixed plan
+def bench_mixed_plan() -> dict:
+    """One planner, four backends (sparse/plan.py): a HETEROGENEOUS-mask
+    model — dead conv channels (compaction's structure) plus a scattered
+    in-axis 2:4 pattern on the fc stack (gathering's structure) — timed as
+    a full train step under every backend the planner can emit:
+    masked-dense, compact-only, nm-only, and the MIXED plan that routes
+    each layer to whichever backend its own mask population pays for.
+
+    Every variant is produced by plan_execution with per-variant forced
+    modes — the planner is the only code deciding widths/index maps, so
+    the bench exercises the exact decision path the harness and the
+    serving engine run. The mixed record carries the machine-readable
+    per-layer decision table (backend + reason + cost-model est_gain),
+    the compaction commit decision, the unrouted-eligible layer names,
+    forward/grad parity vs masked-dense, and the per-variant steady-state
+    recompile count (jit cache size - 1 after the timing loop).
+
+    CPU-pinned subprocess (see the stage wrapper): the win being measured
+    is reduced GEMM width + sliced conv channels, which is chip-agnostic;
+    the fc stack is deliberately wide (3136 -> 512 -> 512) so the gathered
+    path's contribution is visible next to the conv slicing."""
+    from turboprune_tpu.models.vgg import VGG
+    from turboprune_tpu.ops import masking
+    from turboprune_tpu.sparse import (
+        build_graph,
+        compact_train_state,
+        plan_execution,
+        project_masks,
+    )
+    from turboprune_tpu.sparse.compact import (
+        compact_stats,
+        compact_tree,
+        expand_tree,
+    )
+    from turboprune_tpu.train import (
+        create_optimizer,
+        create_train_state,
+        make_train_step,
+    )
+
+    batch, image = 16, 32
+    cfg = [16, "M", 32, "M", 32, 32, "M", 64, 64, "M", 64, 64, "M"]
+
+    def make_model(width_overrides=None, nm_overrides=None):
+        return VGG(
+            cfg, 100, batch_norm=True, fc_features=(512, 512),
+            dropout_rate=0.0,
+            width_overrides=(
+                tuple(sorted(dict(width_overrides).items()))
+                if width_overrides else None
+            ),
+            nm_overrides=nm_overrides,
+        )
+
+    model = make_model()
+    tx = create_optimizer("SGD", 0.05, momentum=0.9, weight_decay=0.0)
+    state0 = create_train_state(
+        # graftlint: disable=rng-key-reuse -- fixed seed on purpose: identical weights/masks every bench round
+        model, tx, jax.random.PRNGKey(0), (1, image, image, 3)
+    )
+    graph = build_graph(model, state0.params)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(
+        rng.standard_normal((batch, image, image, 3)).astype(np.float32)
+    )
+    batch_data = (
+        x, jnp.asarray(rng.integers(0, 100, size=(batch,)).astype(np.int32))
+    )
+
+    # Heterogeneous masks: kill half of every CONV channel space (smallest
+    # fan-out L2), then project the fc stack in-axis 2:4 — in-axis only,
+    # so the fc widths stay live and the fc population is purely the
+    # gathered path's structure, not compaction's.
+    masks = _channel_structured_masks(
+        state0.params, graph, 0.5, spaces=lambda name: name.startswith("conv")
+    )
+    masks, _ = project_masks(state0.params, masks, 2, 4, transposable=False)
+    st = state0.replace(masks=masks, opt_state=tx.init(state0.params))
+    folded = masking.apply_masks(state0.params, masks)
+
+    def timed_step(step, stv) -> float:
+        out, _ = step(stv, batch_data)
+        jax.block_until_ready(out.params)  # compile + sync
+        best = float("inf")
+        for _ in range(2):
+            cur = stv
+            t0 = time.perf_counter()
+            for _ in range(4):
+                cur, _ = step(cur, batch_data)
+            jax.block_until_ready(cur.params)
+            best = min(best, (time.perf_counter() - t0) / 4)
+        return best
+
+    fields: dict = {
+        "mixed_plan_model": "vgg_small_fc512",
+        "mixed_plan_batch": batch,
+        "mixed_plan_image": image,
+        "mixed_plan_sparsity_pct": round(masking.overall_sparsity(masks), 2),
+    }
+
+    # (variant, compact mode, nm mode, autotune) — every backend decision
+    # below comes out of the one planner, never hand-assembled.
+    variants = (
+        ("masked", "off", "off", "off"),
+        ("compact", "force", "off", "off"),
+        ("nm", "off", "auto", "off"),
+        ("mixed", "auto", "auto", "cost"),
+    )
+    step_ms: dict[str, float] = {}
+    mixed_plan = None
+    for name, cmode, nmode, tune in variants:
+        plan = plan_execution(
+            model, st.params, st.masks, st.batch_stats,
+            model_factory=make_model, compact=cmode, nm=nmode,
+            compact_min_savings=0.0, autotune=tune,
+        )
+        exec_model = (
+            make_model(
+                plan.width_overrides,
+                plan.nm.as_override_tuple() if plan.nm else None,
+            )
+            if (plan.width_overrides or plan.nm_overrides) else model
+        )
+        # device_put: compact_train_state returns numpy (uncommitted)
+        # leaves, and the jit cache keys on committed-ness — without it the
+        # first chained step counts as a spurious "recompile".
+        stv = (
+            jax.device_put(compact_train_state(st, plan.compaction))
+            if plan.compaction else st
+        )
+        # Each variant IS a different program (widths/index maps are module
+        # metadata) — one compile per variant is the thing being measured.
+        # graftlint: disable=retrace-hazard -- one jit per planner variant by design: widths/index maps differ per variant, executable reused across the timing loop
+        step = jax.jit(make_train_step(exec_model, tx))
+        t = timed_step(step, stv)
+        step_ms[name] = t
+        fields[f"mixed_plan_{name}_step_ms"] = round(t * 1e3, 2)
+        fields[f"mixed_plan_{name}_steady_state_recompiles"] = (
+            step._cache_size() - 1
+        )
+        if name != "masked":
+            fields[f"mixed_plan_{name}_speedup_vs_masked"] = round(
+                step_ms["masked"] / t, 3
+            )
+        if name == "mixed":
+            mixed_plan = plan
+
+            # Forward parity vs masked-dense on the SAME folded weights.
+            p_small = compact_tree(folded, plan.compaction)
+            s_small = compact_stats(st.batch_stats, plan.compaction)
+            y_dense = model.apply(
+                {"params": folded, "batch_stats": st.batch_stats},
+                x, train=False,
+            )
+            y_mixed = exec_model.apply(
+                {"params": p_small, "batch_stats": s_small}, x, train=False
+            )
+            fields["mixed_plan_fwd_parity_max_abs_diff"] = float(
+                jnp.max(jnp.abs(y_dense - y_mixed))
+            )
+
+            # Grad parity over MATERIALIZED coordinates (removed coords
+            # are frozen by design; the harness's anchor expansion carries
+            # them — see tests/test_plan.py).
+            m_small = compact_tree(masks, plan.compaction)
+
+            def dense_loss(p):
+                var = {
+                    "params": masking.apply_masks(p, masks),
+                    "batch_stats": st.batch_stats,
+                }
+                return (model.apply(var, x, train=False) ** 2).sum()
+
+            def mixed_loss(p):
+                var = {
+                    "params": masking.apply_masks(p, m_small),
+                    "batch_stats": s_small,
+                }
+                return (exec_model.apply(var, x, train=False) ** 2).sum()
+
+            g_d = jax.grad(dense_loss)(state0.params)
+            g_m = jax.grad(mixed_loss)(compact_tree(state0.params, plan.compaction))
+            ind = expand_tree(
+                jax.tree.map(np.ones_like, g_m), plan.compaction
+            )
+            g_m_full = expand_tree(g_m, plan.compaction)
+            fields["mixed_plan_grad_parity_max_abs_diff"] = max(
+                jax.tree.leaves(
+                    jax.tree.map(
+                        lambda a, b, i: float(
+                            np.max(np.abs(np.asarray(a) * i - np.asarray(b)))
+                        ),
+                        g_d, g_m_full, ind,
+                    )
+                )
+            )
+
+    # The headline claim: the planner's mix is at least as fast as the
+    # best single backend it could have chosen.
+    best_single = min(step_ms["masked"], step_ms["compact"], step_ms["nm"])
+    fields["mixed_plan_best_single_ms"] = round(best_single * 1e3, 2)
+    fields["mixed_plan_mixed_vs_best_single"] = round(
+        best_single / step_ms["mixed"], 3
+    )
+
+    # Machine-readable decision table for the mixed plan: every per-layer
+    # call (backend + reason + cost-model gain) and the compaction commit.
+    rep = mixed_plan.report
+    fields["mixed_plan_kind"] = rep["kind"]
+    fields["mixed_plan_compaction_decision"] = mixed_plan.decisions[
+        "compaction"
+    ]
+    fields["mixed_plan_decision_table"] = mixed_plan.decisions["layers"]
+    fields["mixed_plan_backend_counts"] = rep["backend_counts"]
+    fields["mixed_plan_coverage_frac"] = round(rep["coverage_frac"], 4)
+    fields["mixed_plan_unrouted_eligible"] = sorted(
+        name
+        for name, r in (rep["nm"] or {"layers": {}})["layers"].items()
+        if not r["routed"]
+    )
     return fields
 
 
@@ -1484,6 +1724,29 @@ def main() -> None:
 
     run_stage("nm_frontier", stage_nm_frontier)
 
+    def stage_mixed_plan() -> dict:
+        """CPU-pinned SUBPROCESS like nm_frontier: the planner's backend
+        mix is compared in per-step CPU milliseconds by definition, so a
+        dead accelerator tunnel must not block it."""
+        import subprocess
+
+        out = subprocess.run(
+            [sys.executable, str(Path(__file__).resolve()), "--mixed-plan"],
+            capture_output=True,
+            text=True,
+            cwd=str(Path(__file__).resolve().parent),
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            timeout=600,
+        )
+        for line in out.stdout.splitlines():
+            if line.startswith("MIXED_PLAN "):
+                return json.loads(line[len("MIXED_PLAN "):])
+        raise RuntimeError(
+            f"mixed_plan subprocess failed: {out.stderr[-400:]}"
+        )
+
+    run_stage("mixed_plan", stage_mixed_plan)
+
     def stage_serving_load() -> dict:
         """CPU-pinned SUBPROCESS like nm_frontier: the open-loop sweep
         measures the serving stack on host CPU by definition, so a dead
@@ -1517,6 +1780,9 @@ if __name__ == "__main__":
     if "--nm-frontier" in sys.argv:
         # Child mode for the nm_frontier stage (CPU-pinned by the parent).
         print("NM_FRONTIER " + json.dumps(bench_nm_frontier()), flush=True)
+    elif "--mixed-plan" in sys.argv:
+        # Child mode for the mixed_plan stage (CPU-pinned by the parent).
+        print("MIXED_PLAN " + json.dumps(bench_mixed_plan()), flush=True)
     elif "--serving-load" in sys.argv:
         # Child mode for the serving_load stage (CPU-pinned by the parent).
         print("SERVING_LOAD " + json.dumps(bench_serving_load()), flush=True)

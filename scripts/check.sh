@@ -1,5 +1,5 @@
 #!/usr/bin/env bash
-# Pre-PR gate, ten stages:
+# Pre-PR gate, eleven stages:
 #   1. graftlint --changed      — per-file rules on just the .py/.yaml
 #      files changed vs the merge-base with main (fast half; stays
 #      O(diff) as the repo grows)
@@ -27,32 +27,39 @@
 #      the dense step functions with one cached executable, stale plans
 #      evict, and compact_train composes. Isolated so an N:M regression
 #      is named before the full suite runs.
-#   6. serving-load smoke       — the fleet serving drain + open-loop
+#   6. planner smoke            — the one-planner decision table + mixed
+#      lifecycle (sparse/plan.py): every mask population lands on the
+#      right backend with machine-readable reasons, autotune demotes
+#      layers where gathering loses, mixed-plan logits/grads match
+#      masked-dense on VGG and ViT, and the 3-level harness lifecycle
+#      enters ONE mixed bundle and evicts it stale. Isolated so a
+#      planner regression is named before the full suite runs.
+#   7. serving-load smoke       — the fleet serving drain + open-loop
 #      load generator on a jax-free fake engine: graceful drain answers
 #      in-flight work then sheds, and the Poisson sweep finds the
 #      saturation knee at the overloaded point, not the healthy one.
 #      Isolated (and jax-light, so it's fast) because loadgen bugs
 #      otherwise surface as flaky latency numbers in BENCH, not as a
 #      named failure.
-#   7. graftsan smoke           — the runtime lock-order sanitizer drives
+#   8. graftsan smoke           — the runtime lock-order sanitizer drives
 #      the PrefetchEngine (pool decoders + transfer thread + racing
 #      closes) and a 2-model FleetEngine under 1-slot LRU churn with
 #      every package lock wrapped: an observed lock-order cycle, a
 #      self-deadlock, or a shared-write race the static layer never
 #      claimed (a lexical-model blind spot) fails the stage. Dynamic
 #      mirror of stage 2, exactly as stage 3 mirrors the dtype rules.
-#   8. exec-manifest round-trip — rebuild the static compile-surface
+#   9. exec-manifest round-trip — rebuild the static compile-surface
 #      manifest (jit entries x compile sites x bucket sets x plan kinds)
 #      and diff it against the checked-in
 #      turboprune_tpu/analysis/exec_manifest.json. Drift means code grew
 #      or moved an executable the manifest doesn't know: re-emit with
 #      --exec-manifest emit and review the diff like a lockfile change.
-#   9. compile audit            — the runtime mirror of stage 8: patch
+#  10. compile audit            — the runtime mirror of stage 8: patch
 #      jax's backend_compile, drive the serving engine (warmup + padded
 #      predict) and the jitted train step, and fail on any XLA compile
 #      not attributed to a manifest entry, or any compiled (plan,
 #      bucket) outside the declared surface.
-#  10. tier-1 fast tests        — the same command ROADMAP.md pins,
+#  11. tier-1 fast tests        — the same command ROADMAP.md pins,
 #      including its plugin surface (-p no:xdist -p no:randomly), so the
 #      gate and tier-1 agree on what "the suite" is.
 # Each stage prints its wall time (even when it fails, so slow-AND-broken
@@ -88,6 +95,11 @@ run_stage "compact-train smoke (harness lifecycle on synthetic .tpk)" \
 run_stage "nm smoke (gathered N:M lifecycle on synthetic .tpk)" \
     env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_nm.py::TestHarnessNMSmoke -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
+run_stage "planner smoke (decision table + mixed plan lifecycle)" \
+    env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_plan.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
 run_stage "serving-load smoke (drain + open-loop knee, fake engine)" \

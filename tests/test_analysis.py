@@ -2748,7 +2748,7 @@ class TestExecManifest:
         )
 
         m = build_manifest()
-        assert set(m["plan_kinds"]) == {"compact", "masked", "nm"}
+        assert set(m["plan_kinds"]) == {"compact", "masked", "mixed", "nm"}
         assert set(m["buckets"]) == {1, 8, 32, 128}
         names = executable_names(m)
         # the factory-resolved eval step and the engine's jit target

@@ -311,7 +311,7 @@ class TestHarnessCompactTrainSmoke:
                 "experiment_params.max_steps_per_epoch=2",
                 "experiment_params.training_precision=float32",
                 "experiment_params.compact_train=true",
-                "experiment_params.compact_min_savings=0.1",
+                "planner.compact_min_savings=0.1",
                 "optimizer_params.lr=0.01",
                 "optimizer_params.weight_decay=0.0",
                 "model_params.model_name=resnet18",
@@ -330,7 +330,7 @@ class TestHarnessCompactTrainSmoke:
         full_shapes = jax.tree.map(lambda a: a.shape, h.state.params)
 
         h.train_one_level(1, 0)
-        assert h._compact_ctx is None
+        assert h._plan_ctx is None
         assert h.last_compaction_report is None, "level 0 must train dense"
 
         self._kill(h, 0.5)
@@ -343,7 +343,7 @@ class TestHarnessCompactTrainSmoke:
         s1 = h.train_one_level(1, 1)
 
         # Re-instantiated smaller, and exited back to full coordinates.
-        assert h._compact_ctx is None
+        assert h._plan_ctx is None
         rep = h.last_compaction_report
         assert rep is not None
         assert rep["params_after"] < rep["params_before"]
@@ -367,15 +367,15 @@ class TestHarnessCompactTrainSmoke:
 
         # Gauges export the size the level ACTUALLY compiled.
         snap = h.compact_metrics.snapshot()
-        assert snap["compaction_params_compacted"] == rep["params_after"]
-        assert snap["compact_train_cache_size"] == 1
+        assert snap["plan_params_compacted"] == rep["params_after"]
+        assert snap["plan_step_cache_size"] == 1
 
         # Level 2 at strictly smaller widths: stale caches must be evicted,
         # not accumulated (widths never grow back).
-        keys_l1 = set(h._compact_step_cache)
+        keys_l1 = set(h._plan_step_cache)
         self._kill(h, 0.75)
         h.train_one_level(1, 2)
-        assert set(h._compact_step_cache).isdisjoint(keys_l1)
+        assert set(h._plan_step_cache).isdisjoint(keys_l1)
         snap = h.compact_metrics.snapshot()
-        assert snap["compact_train_cache_size"] == 1
-        assert snap["compact_eval_cache_size"] == 0  # compact_eval off
+        assert snap["plan_step_cache_size"] == 1
+        assert snap["plan_eval_cache_size"] == 0  # compact_eval off

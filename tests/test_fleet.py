@@ -400,11 +400,16 @@ class TestFleetEngine:
         kinds = {meta["plan_kind"] for meta in ledger.values()}
         assert kinds == {"masked", "compact", "nm"}
         assert kinds <= set(manifest["plan_kinds"])
+        # The planner's fourth kind is declared even when this fixture's
+        # checkpoints each collapse to a single backend: a heterogeneous
+        # checkpoint mints ("mixed", widths, nm) keys, and the manifest
+        # must already cover them.
+        assert "mixed" in manifest["plan_kinds"]
         assert {meta["bucket"] for meta in ledger.values()} <= set(BUCKETS)
         # The production bucket set is covered end to end for every kind
         # this fleet exercised (the test fleet's (2,) is a deliberate
         # override; DEFAULT_BUCKETS is what ships).
-        for kind in kinds:
+        for kind in kinds | {"mixed"}:
             assert all(covers(manifest, kind, b) for b in manifest["buckets"])
         assert not covers(manifest, "mystery-plan", manifest["buckets"][0])
 
@@ -447,24 +452,24 @@ class TestFleetEngine:
 class TestMetricsLabels:
     def test_two_models_same_metric_render_distinct_series(self):
         """The PR-11 collision fix: before the hub, two engines writing
-        compaction_params_compacted silently overwrote each other."""
+        plan_params_compacted silently overwrote each other."""
         hub = MetricsHub()
-        hub.get("level_0").set_gauge("compaction_params_compacted", 50)
-        hub.get("level_1").set_gauge("compaction_params_compacted", 80)
+        hub.get("level_0").set_gauge("plan_params_compacted", 50)
+        hub.get("level_1").set_gauge("plan_params_compacted", 80)
         text = hub.render_prometheus()
         assert (
-            'turboprune_serve_compaction_params_compacted{model="level_0"} 50'
+            'turboprune_serve_plan_params_compacted{model="level_0"} 50'
             in text
         )
         assert (
-            'turboprune_serve_compaction_params_compacted{model="level_1"} 80'
+            'turboprune_serve_plan_params_compacted{model="level_1"} 80'
             in text
         )
         # exactly one TYPE line per metric name (the spec requirement that
         # rules out naive per-model concatenation)
         assert (
             text.count(
-                "# TYPE turboprune_serve_compaction_params_compacted gauge"
+                "# TYPE turboprune_serve_plan_params_compacted gauge"
             )
             == 1
         )

@@ -465,7 +465,7 @@ class TestHarnessNMSmoke:
         )
 
         h.train_one_level(1, 0)
-        assert h._nm_ctx is None
+        assert h._plan_ctx is None
         rep = h.last_nm_report
         assert rep is not None and rep["coverage_frac"] == 0.0, (
             "dense level-0 masks must not route"
@@ -479,17 +479,17 @@ class TestHarnessNMSmoke:
         assert fc_mask.any(axis=0).all()
 
         s1 = h.train_one_level(1, 1)
-        assert h._nm_ctx is None, "exit must restore dense fns in finally"
+        assert h._plan_ctx is None, "exit must restore dense fns in finally"
         rep = h.last_nm_report
         assert rep["coverage_frac"] > 0.0
         fc = rep["layers"]["fc/kernel"]
         assert fc["routed"] and fc["kept_in_frac"] == pytest.approx(0.5)
         assert fc["kept_out_frac"] == 1.0
-        assert len(h._nm_step_cache) == 1
-        keys_l1 = set(h._nm_step_cache)
+        assert len(h._plan_step_cache) == 1
+        keys_l1 = set(h._plan_step_cache)
         snap = h.compact_metrics.snapshot()
-        assert snap["nm_exec_cache_size"] == 1
-        assert snap["nm_coverage_frac"] == pytest.approx(rep["coverage_frac"])
+        assert snap["plan_step_cache_size"] == 1
+        assert snap["plan_coverage_frac"] == pytest.approx(rep["coverage_frac"])
         assert s1["test_acc"] >= 0.0
 
         # A further prune must evict the stale plan's executable. With only
@@ -508,8 +508,8 @@ class TestHarnessNMSmoke:
         fc_mask[blk * 4 : blk * 4 + 4, :] = False
         h.state = h.state.replace(masks=masks)
         h.train_one_level(1, 2)
-        assert len(h._nm_step_cache) == 1
-        assert set(h._nm_step_cache).isdisjoint(keys_l1)
+        assert len(h._plan_step_cache) == 1
+        assert set(h._plan_step_cache).isdisjoint(keys_l1)
 
     def test_composes_with_compact_train(self, tmp_path):
         """Channel-compact first, N:M the survivors: with whole channels
@@ -523,7 +523,7 @@ class TestHarnessNMSmoke:
             tmp_path,
             extra=(
                 "experiment_params.compact_train=true",
-                "experiment_params.compact_min_savings=0.1",
+                "planner.compact_min_savings=0.1",
             ),
         )
         graph = build_graph(h.model, h.state.params)
@@ -542,7 +542,7 @@ class TestHarnessNMSmoke:
         h.state = h.state.replace(masks=masks)
 
         h.train_one_level(1, 1)
-        assert h._compact_ctx is None and h._nm_ctx is None
+        assert h._plan_ctx is None
         crep = h.last_compaction_report
         assert crep is not None and crep["params_after"] < crep["params_before"]
         nrep = h.last_nm_report

@@ -193,7 +193,7 @@ class TestEngine:
         assert eng.density < 1.0
         info = eng.info()["compaction"]
         assert info["params_after"] <= info["params_before"]
-        assert metrics.snapshot()["compaction_params_compacted"] == info[
+        assert metrics.snapshot()["plan_params_compacted"] == info[
             "params_after"
         ]
         rng = np.random.default_rng(7)

@@ -301,12 +301,8 @@ class ExperimentConfig:
     # the model at the smaller widths, and run the level's epochs on the
     # physically smaller program — expanding back to full coordinates
     # before pruning, rewind saves and checkpoints (README "Sparsity
-    # execution"). Ignored for levels below the savings threshold.
+    # execution"). Levels below planner.compact_min_savings stay dense.
     compact_train: bool = False
-    # Minimum fraction of parameters the slicing must remove before a
-    # level is re-instantiated small (compile + state-slice overhead must
-    # be worth it). 0 re-instantiates on any nonzero shrinkage.
-    compact_min_savings: float = 0.25
     # N:M structured sparsity (sparse/nm.py): "" / null = off. When set,
     # every prune step projects the masks of matmul-heavy layers onto the
     # highest-magnitude-preserving N:M pattern and the level loop swaps
@@ -335,8 +331,6 @@ class ExperimentConfig:
             raise ConfigError("model_parallelism must be >= 1")
         if self.checkpoint_every_epochs < 0:
             raise ConfigError("checkpoint_every_epochs must be >= 0")
-        if not (0.0 <= self.compact_min_savings < 1.0):
-            raise ConfigError("compact_min_savings must be in [0, 1)")
 
 
 @dataclass
@@ -359,13 +353,48 @@ class OptimizerConfig:
             raise ConfigError("warmup_fraction must be in [0, 1]")
 
 
+# Execution-planner autotune modes (sparse/plan.py): off = threshold
+# routing only; cost = analytic gather-overhead model demotes N:M layers
+# that would lose to masked-dense; measure = per-layer jitted micro-bench
+# on the host platform decides instead.
+PLANNER_AUTOTUNE_MODES = ("off", "cost", "measure")
+
+
+@dataclass
+class PlannerConfig:
+    """Execution-planner routing knobs (sparse/plan.py): ONE config surface
+    for the thresholds that decide which sparse backend each level/layer
+    runs, shared by the harness, serving, and the bench."""
+
+    # Minimum fraction of parameters channel-slicing must remove before a
+    # level is re-instantiated physically smaller (compile + state-slice
+    # overhead must be worth it). 0 re-instantiates on any nonzero
+    # shrinkage — serving uses 0 internally (no optimizer state to slice).
+    compact_min_savings: float = 0.25
+    # Minimum fraction of the contraction axis the gathered N:M path must
+    # drop before a layer routes through it — below that the gather
+    # overhead eats the reduced-GEMM win. Any projected N:M pattern
+    # (N/M <= 1/2) clears the default.
+    nm_min_axis_savings: float = 0.25
+    # Autotune pass over the routed N:M layers vs the masked-dense floor.
+    autotune: str = "off"
+
+    def validate(self) -> None:
+        _check_choice("planner.autotune", self.autotune, PLANNER_AUTOTUNE_MODES)
+        if not (0.0 <= self.compact_min_savings < 1.0):
+            raise ConfigError("planner.compact_min_savings must be in [0, 1)")
+        if not (0.0 <= self.nm_min_axis_savings < 1.0):
+            raise ConfigError("planner.nm_min_axis_savings must be in [0, 1)")
+
+
 # Fleet request routing when a request carries no "model" field: the
 # sparsest (latest) level, the dense (lowest) level, or a pinned id.
 FLEET_ROUTES = ("latest", "dense", "pinned")
-# Per-checkpoint execution backend: auto picks compact when dead channels
-# actually shrink the model, else nm when the plan routes a layer, else
-# masked-dense.
-FLEET_BACKENDS = ("auto", "masked", "compact", "nm")
+# Per-checkpoint execution backend, resolved by the one planner
+# (sparse/plan.py): auto/mixed let the planner compose — compact where dead
+# channels actually shrink the model AND N:M where a layer routes — while
+# masked/compact/nm pin a single backend.
+FLEET_BACKENDS = ("auto", "masked", "compact", "nm", "mixed")
 
 
 @dataclass
@@ -504,6 +533,10 @@ class MainConfig:
     cyclic_training: CyclicTrainingConfig = field(
         default_factory=CyclicTrainingConfig
     )
+    # Execution-planner thresholds (sparse/plan.py). No conf/ group of its
+    # own: the defaults are right for every preset, dotted overrides
+    # (``planner.compact_min_savings=0.1``) tune individual knobs.
+    planner: PlannerConfig = field(default_factory=PlannerConfig)
     # Inference serving (run_server.py); optional — training configs don't
     # carry it, serving composes it from the conf/serve/ group.
     serve: Optional[ServeConfig] = None
@@ -625,6 +658,7 @@ _NESTED = {
     "PruneConfig": PruneConfig,
     "ExperimentConfig": ExperimentConfig,
     "OptimizerConfig": OptimizerConfig,
+    "PlannerConfig": PlannerConfig,
     "CyclicTrainingConfig": CyclicTrainingConfig,
     "ResumeExperimentConfig": ResumeExperimentConfig,
     "ServeConfig": ServeConfig,

@@ -59,15 +59,14 @@ class CyclicPruningHarness(PruningHarness):
         for cycle, epochs in enumerate(cycle_epochs):
             # Fresh optimizer + schedule per cycle: the LR re-warms from the
             # schedule's start (cyclic_harness.py:180-194). setup_level
-            # re-inits the optimizer from FULL params, so compact training
-            # enters/exits per cycle — the small step bundle is cached by
-            # (total_steps, widths) and cycles with equal epoch budgets
-            # reuse one executable.
+            # re-inits the optimizer from FULL params, so the execution plan
+            # enters/exits per cycle — the planned step bundle is cached by
+            # (total_steps, widths, nm signature) and cycles with equal
+            # epoch budgets reuse one executable.
             self.setup_level(epochs)
             if cycle == 0:
                 self.maybe_rewind_optimizer(level)
-            self._maybe_enter_compact_train()
-            self._maybe_enter_nm_exec()
+            self._enter_plan()
             try:
                 for epoch in range(epochs):
                     row = {"level": level, "cycle": cycle, "epoch": epoch}
@@ -94,8 +93,7 @@ class CyclicPruningHarness(PruningHarness):
                             OPTIMIZER_REWIND, full.opt_state
                         )
             finally:
-                self._exit_nm_exec()
-                self._exit_compact_train()
+                self._exit_plan()
 
         return self.metrics.finish_level(
             level,

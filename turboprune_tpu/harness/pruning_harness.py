@@ -178,26 +178,23 @@ class PruningHarness:
         # eval steps cached by the compacted width signature — widths only
         # change when the masks do (once per level), so per-epoch evals
         # reuse one executable.
-        self._compact_eval_cache: dict[tuple, Any] = {}
+        self._plan_eval_cache: dict[tuple, Any] = {}
         self.last_compaction_report: Optional[dict] = None
-        # Opt-in compact TRAINING (experiment_params.compact_train): once a
-        # level's dead-channel savings clear compact_min_savings, the whole
-        # level trains on a physically re-instantiated smaller model. The
-        # per-width step bundle is cached by (total_steps, width signature);
-        # _compact_ctx holds the plan + the full-coordinate anchor while the
-        # small run is live (None <=> training dense). Cache sizes and the
-        # last compaction report are exported on compact_metrics so the
-        # bench/tests can read the size the level ACTUALLY compiled.
-        self._compact_step_cache: dict[tuple, tuple] = {}
-        self._compact_ctx: Optional[dict] = None
-        # Opt-in gathered N:M execution (experiment_params.nm_sparsity): once
-        # a level's masks carry a separable N:M pattern (sparse/nm.py), the
-        # level trains/evals through the reduced-width gathered path
-        # (sparse/nm_execute.py) at FULL param shapes — a function swap only,
-        # no state transformation and no anchor. Step bundles are cached by
-        # (total_steps, compact width signature, nm index signature).
-        self._nm_step_cache: dict[tuple, tuple] = {}
-        self._nm_ctx: Optional[dict] = None
+        # Sparse-backend execution (experiment_params.compact_train and/or
+        # nm_sparsity): at each level boundary ONE planner
+        # (sparse/plan.py plan_execution) derives an ExecutionPlan from the
+        # live masks — slice the whole train state onto a physically smaller
+        # model where dead channels clear the savings threshold, gather the
+        # surviving N:M-patterned contractions, and stay masked-dense where
+        # neither pays. The per-plan step bundle is cached by
+        # (total_steps, width signature, nm signature); _plan_ctx holds the
+        # plan + the full-coordinate anchor (compaction only) while the
+        # level runs on it (None <=> training masked-dense). Cache sizes and
+        # the last plan report are exported on compact_metrics so the
+        # bench/tests can read the shape the level ACTUALLY compiled.
+        self._plan_step_cache: dict[tuple, tuple] = {}
+        self._plan_ctx: Optional[dict] = None
+        self.last_plan_report: Optional[dict] = None
         self.last_nm_report: Optional[dict] = None
         if ep.nm_sparsity:
             # Fail fast at harness construction: a contraction width that
@@ -365,16 +362,13 @@ class PruningHarness:
             ev_state = ev_state.replace(
                 params=eval_params(ev_state.opt_state, ev_state.params)
             )
-        if (
-            self.cfg.experiment_params.compact_eval
-            and self._compact_ctx is None
-            and self._nm_ctx is None
-        ):
-            # With compact TRAINING live the state is already small and
-            # _eval_step/_scan_eval are the small model's — re-compacting
-            # sliced params against the full model's graph would be wrong.
-            # With N:M execution live, _eval_step already runs the gathered
-            # reduced-width path — that IS the level's compact eval.
+        if self.cfg.experiment_params.compact_eval and self._plan_ctx is None:
+            # With an ExecutionPlan live the state/step functions already run
+            # the planned shape — compact: the state is small and _eval_step
+            # is the small model's (re-compacting sliced params against the
+            # full model's graph would be wrong); N:M: _eval_step already
+            # runs the gathered reduced-width path. Either way that IS the
+            # level's compact eval.
             return self._evaluate_compacted(ev_state)
         test_loader = self.loaders.test_loader
         if hasattr(test_loader, "eval_epoch_arrays"):
@@ -420,13 +414,13 @@ class PruningHarness:
         )
         self.last_compaction_report = res.report
         key = res.as_override_tuple()
-        if key not in self._compact_eval_cache:
-            self._evict_stale_compact_caches(key)
-            self._compact_eval_cache[key] = jax.jit(
+        if key not in self._plan_eval_cache:
+            self._evict_stale_plan_caches(key)
+            self._plan_eval_cache[key] = jax.jit(
                 make_eval_step(self._small_model(res.width_overrides))
             )
             self._export_cache_gauges()
-        step = self._compact_eval_cache[key]
+        step = self._plan_eval_cache[key]
         # make_eval_step multiplies masks into params; all-ones masks on
         # the compacted (already folded) params make that an exact no-op,
         # so the metric/padding semantics are shared with the dense path.
@@ -451,7 +445,7 @@ class PruningHarness:
             "test_acc": 100.0 * float(sums["correct"]) / n,
         }
 
-    # ------------------------------------------------------- compact train
+    # ----------------------------------------------------- plan execution
     def _small_model(self, width_overrides, nm_overrides=None):
         """Re-instantiate the architecture at compacted widths and/or with
         gathered N:M hooks. Ring attention falls back to its param-identical
@@ -471,172 +465,93 @@ class PruningHarness:
             nm_overrides=nm_overrides,
         )
 
-    def _maybe_enter_compact_train(self) -> None:
-        """Swap the level onto a physically smaller model when the masks'
-        dead-channel savings clear ``compact_min_savings``.
+    def _enter_plan(self) -> None:
+        """Derive this level's ExecutionPlan from the live masks and swap
+        the step bundle onto it (sparse/plan.py plan_execution — the ONE
+        producer of backend decisions).
 
-        The FULL state at entry is kept as the anchor: at exit (and for any
-        checkpoint written mid-level) the trained small state is scattered
-        back over it, so removed coordinates — including consumer in-rows
-        of dead channels, whose real magnitudes the next level's GLOBAL
-        threshold must still see — come back exactly as the dense run would
-        have left them (exact for weight_decay=0 with the per-level fresh
-        optimizer; a removed coordinate then sees zero gradient and zero
-        momentum, i.e. it never moves)."""
-        ep = self.cfg.experiment_params
-        if not ep.compact_train or self._compact_ctx is not None:
-            return
-        from ..sparse import (
-            CompactionError,
-            build_graph,
-            build_plan,
-            compact_train_state,
-            width_signature,
-        )
+        The planner decides everything the old compact-then-nm enter pair
+        decided, in one place: slice the whole train state onto a
+        physically smaller model when dead-channel savings clear
+        ``planner.compact_min_savings``, gather the surviving N:M-patterned
+        contractions, stay masked-dense where neither pays. When compaction
+        commits, the FULL state at entry is kept as the anchor: at exit
+        (and for any checkpoint written mid-level) the trained small state
+        is scattered back over it, so removed coordinates — including
+        consumer in-rows of dead channels, whose real magnitudes the next
+        level's GLOBAL threshold must still see — come back exactly as the
+        dense run would have left them (exact for weight_decay=0 with the
+        per-level fresh optimizer; a removed coordinate then sees zero
+        gradient and zero momentum, i.e. it never moves). The N:M half is a
+        function swap at the planned shapes — no state transformation.
 
-        plan = None
-        commit = False
-        sig: dict = {"commit": False}
-        try:
-            graph = build_graph(self.model, self.state.params)
-            plan = build_plan(
-                self.state.params, self.state.masks, graph, self.state.batch_stats
-            )
-            commit = plan.savings() >= ep.compact_min_savings
-            if commit:
-                sig = {"commit": True, "widths": width_signature(plan)}
-        except CompactionError as e:
-            # Un-compactable masks (e.g. a zero-width space): train dense.
-            sig = {"commit": False, "error": str(e)}
-        # Collective — every process must reach this call, with its decision
-        # (including a failure) encoded in the signature; skipping it on one
-        # host would deadlock the others inside the allgather.
-        assert_width_agreement(sig)
-        if not commit:
-            return
-
-        total_steps = self._current_epochs * self.steps_per_epoch
-        width_key = plan.as_override_tuple()
-        key = (total_steps, width_key)
-        self._evict_stale_compact_caches(width_key)
-        if key not in self._compact_step_cache:
-            small_model = self._small_model(plan.width_overrides)
-            tx, schedule = self._build_tx(self._current_epochs)
-            raw_step = make_train_step(small_model, tx, schedule)
-            raw_eval = make_eval_step(small_model)
-            self._compact_step_cache[key] = (
-                make_sharded_train_step(raw_step, self.mesh),
-                make_sharded_scan_epoch(make_scan_epoch(raw_step), self.mesh),
-                make_sharded_scan_chunk(make_scan_chunk(raw_step), self.mesh),
-                make_sharded_eval_step(raw_eval, self.mesh),
-                make_sharded_scan_eval(make_scan_eval(raw_eval), self.mesh),
-            )
-        self._export_cache_gauges()
-        self._compact_ctx = {
-            "plan": plan,
-            "anchor": self.state,
-            "dense_fns": (
-                self._train_step,
-                self._scan_epoch,
-                self._scan_chunk,
-                self._eval_step,
-                self._scan_eval,
-            ),
-        }
-        (
-            self._train_step,
-            self._scan_epoch,
-            self._scan_chunk,
-            self._eval_step,
-            self._scan_eval,
-        ) = self._compact_step_cache[key]
-        self.state = replicate(compact_train_state(self.state, plan), self.mesh)
-        self.last_compaction_report = plan.report
-        self.compact_metrics.record_compaction(plan.report)
-        if is_primary():
-            r = plan.report
-            print(
-                f"[compact-train] level runs physically small: params "
-                f"{r['params_before']:,} -> {r['params_after']:,}, channels "
-                f"{r['channels_before']:,} -> {r['channels_after']:,} "
-                f"({r['compacted_spaces']} spaces)",
-                flush=True,
-            )
-
-    def _exit_compact_train(self) -> None:
-        """Expand back to full coordinates and restore the dense step fns.
-        Idempotent; called in a finally so a raising epoch can't leave the
-        harness stuck small (the driver's save_level/prune always see full
-        coordinates)."""
-        if self._compact_ctx is None:
-            return
-        from ..sparse import expand_train_state
-
-        ctx = self._compact_ctx
-        self._compact_ctx = None
-        (
-            self._train_step,
-            self._scan_epoch,
-            self._scan_chunk,
-            self._eval_step,
-            self._scan_eval,
-        ) = ctx["dense_fns"]
-        self.state = replicate(
-            expand_train_state(self.state, ctx["plan"], anchor=ctx["anchor"]),
-            self.mesh,
-        )
-
-    # ---------------------------------------------------------- nm execute
-    def _maybe_enter_nm_exec(self) -> None:
-        """Swap the level's step functions onto the gathered N:M execution
-        path (sparse/nm_execute.py) when the live masks have reducible
-        contraction axes.
-
-        Called AFTER _maybe_enter_compact_train: the plan is built from the
-        LIVE masks (full-coordinate or compact-sliced — live-row detection
-        is exact either way, which is what makes the two backends compose:
-        channel-compact first, N:M the survivors). Params keep their current
-        shapes — this is a function swap only, no state transformation and
-        no anchor. No collective is needed: the plan is a pure function of
-        the masks + model family, and mask agreement across hosts is already
-        asserted once per level (driver.prune_level's exact
-        check_state_equality), so every process derives the identical plan.
+        The plan is a pure function of the replicated masks + model family
+        (mask agreement across hosts is asserted once per level by
+        driver.prune_level's exact check_state_equality), so every process
+        derives the identical plan without a collective; when compact_train
+        is enabled the width signature is still barriered below because
+        committing changes which jittable program runs.
         """
         ep = self.cfg.experiment_params
-        if not ep.nm_sparsity or self._nm_ctx is not None:
+        if self._plan_ctx is not None:
             return
-        from ..sparse import build_nm_plan
+        compact_mode = "auto" if ep.compact_train else "off"
+        nm_mode = "auto" if ep.nm_sparsity else "off"
+        if compact_mode == "off" and nm_mode == "off":
+            return
+        from ..sparse import plan_execution, width_signature
 
-        in_compact = self._compact_ctx is not None
-        wov = (
-            self._compact_ctx["plan"].width_overrides if in_compact else None
+        pl = self.cfg.planner
+        plan = plan_execution(
+            self.model,
+            self.state.params,
+            self.state.masks,
+            self.state.batch_stats,
+            model_factory=self._small_model,
+            compact=compact_mode,
+            nm=nm_mode,
+            compact_min_savings=pl.compact_min_savings,
+            nm_min_axis_savings=pl.nm_min_axis_savings,
+            autotune=pl.autotune,
         )
-        exec_model = self._small_model(wov) if in_compact else self.model
-        plan = build_nm_plan(exec_model, self.state.masks)
-        self.last_nm_report = plan.report
-        self.compact_metrics.set_gauge(
-            "nm_coverage_frac", plan.report["coverage_frac"]
-        )
-        if not plan.overrides:
-            # Dense or unprojected masks (e.g. level 0): nothing to gather.
+        if ep.compact_train:
+            # Collective — every process must reach this call, with its
+            # decision (including a planner decline or CompactionError)
+            # encoded in the signature; skipping it on one host would
+            # deadlock the others inside the allgather.
+            if plan.compaction is not None:
+                sig = {
+                    "commit": True,
+                    "widths": width_signature(plan.compaction),
+                }
+            else:
+                sig = {
+                    "commit": False,
+                    "reason": plan.report["compaction"]["reason"],
+                }
+            assert_width_agreement(sig)
+        self.last_plan_report = plan.report
+        if plan.report["nm"] is not None:
+            self.last_nm_report = plan.report["nm"]
+        self.compact_metrics.record_plan(plan.report)
+        if plan.kind == "masked":
+            # Neither backend pays at this level: keep the dense bundle.
             return
+        if plan.compaction is not None:
+            self.last_compaction_report = plan.compaction.report
 
         total_steps = self._current_epochs * self.steps_per_epoch
-        width_key = (
-            self._compact_ctx["plan"].as_override_tuple() if in_compact else ()
-        )
-        nm_key = plan.as_override_tuple()
+        width_key, nm_key = plan.width_key(), plan.nm_key()
         key = (total_steps, width_key, nm_key)
-        # The ladder only descends — step bundles for older (level, mask)
-        # signatures can never be hit again.
-        for k in [k for k in self._nm_step_cache if k[1:] != (width_key, nm_key)]:
-            del self._nm_step_cache[k]
-        if key not in self._nm_step_cache:
-            nm_model = self._small_model(wov, nm_overrides=plan.overrides)
+        self._evict_stale_plan_caches(width_key, nm_key)
+        if key not in self._plan_step_cache:
+            exec_model = self._small_model(
+                plan.width_overrides, nm_overrides=plan.nm_overrides
+            )
             tx, schedule = self._build_tx(self._current_epochs)
-            raw_step = make_train_step(nm_model, tx, schedule)
-            raw_eval = make_eval_step(nm_model)
-            self._nm_step_cache[key] = (
+            raw_step = make_train_step(exec_model, tx, schedule)
+            raw_eval = make_eval_step(exec_model)
+            self._plan_step_cache[key] = (
                 make_sharded_train_step(raw_step, self.mesh),
                 make_sharded_scan_epoch(make_scan_epoch(raw_step), self.mesh),
                 make_sharded_scan_chunk(make_scan_chunk(raw_step), self.mesh),
@@ -644,7 +559,9 @@ class PruningHarness:
                 make_sharded_scan_eval(make_scan_eval(raw_eval), self.mesh),
             )
         self._export_cache_gauges()
-        self._nm_ctx = {
+        self._plan_ctx = {
+            "plan": plan,
+            "anchor": self.state if plan.compaction is not None else None,
             "dense_fns": (
                 self._train_step,
                 self._scan_epoch,
@@ -659,24 +576,43 @@ class PruningHarness:
             self._scan_chunk,
             self._eval_step,
             self._scan_eval,
-        ) = self._nm_step_cache[key]
+        ) = self._plan_step_cache[key]
+        if plan.compaction is not None:
+            from ..sparse import compact_train_state
+
+            self.state = replicate(
+                compact_train_state(self.state, plan.compaction), self.mesh
+            )
         if is_primary():
             r = plan.report
+            parts = []
+            comp = r["compaction"]
+            if plan.compaction is not None:
+                parts.append(
+                    f"params {comp['params_before']:,} -> "
+                    f"{comp['params_after']:,} "
+                    f"({r['backend_counts']['compact_spaces']} spaces)"
+                )
+            if plan.nm is not None:
+                parts.append(
+                    f"{r['backend_counts']['nm_layers']} layers gathered "
+                    f"(coverage {r['coverage_frac']:.2f})"
+                )
             print(
-                f"[nm-exec] level runs gathered {ep.nm_sparsity}: "
-                f"{len(plan.overrides)} layers routed, coverage "
-                f"{r['coverage_frac']:.2f} of eligible params",
+                f"[plan] level runs {plan.kind}: " + ", ".join(parts),
                 flush=True,
             )
 
-    def _exit_nm_exec(self) -> None:
-        """Restore the masked-dense step functions. Idempotent; must run
-        BEFORE _exit_compact_train in the level's finally — its stashed fns
-        are the compact model's while compaction is live."""
-        if self._nm_ctx is None:
+    def _exit_plan(self) -> None:
+        """Expand back to full coordinates (when the plan compacted) and
+        restore the masked-dense step functions. Idempotent; called in a
+        finally so a raising epoch can't leave the harness stuck on a
+        plan's shapes (the driver's save_level/prune always see full
+        coordinates)."""
+        if self._plan_ctx is None:
             return
-        ctx = self._nm_ctx
-        self._nm_ctx = None
+        ctx = self._plan_ctx
+        self._plan_ctx = None
         (
             self._train_step,
             self._scan_epoch,
@@ -684,44 +620,61 @@ class PruningHarness:
             self._eval_step,
             self._scan_eval,
         ) = ctx["dense_fns"]
+        plan = ctx["plan"]
+        if plan.compaction is not None:
+            from ..sparse import expand_train_state
+
+            self.state = replicate(
+                expand_train_state(
+                    self.state, plan.compaction, anchor=ctx["anchor"]
+                ),
+                self.mesh,
+            )
 
     def _full_state(self) -> TrainState:
         """The live state in FULL coordinates — what every checkpoint
         (rewind artifacts, mid-level slots) must hold so restores never
         learn the level ran small."""
-        if self._compact_ctx is None:
+        ctx = self._plan_ctx
+        if ctx is None or ctx["plan"].compaction is None:
             return self.state
         from ..sparse import expand_train_state
 
         return expand_train_state(
-            self.state, self._compact_ctx["plan"], anchor=self._compact_ctx["anchor"]
+            self.state, ctx["plan"].compaction, anchor=ctx["anchor"]
         )
 
     def _full_masks(self):
         """Full-coordinate masks for metric rows. Masks never change inside
         a level, so while compacted the anchor's tree IS the current one."""
-        if self._compact_ctx is None:
+        ctx = self._plan_ctx
+        if ctx is None or ctx["plan"].compaction is None:
             return self.state.masks
-        return self._compact_ctx["anchor"].masks
+        return ctx["anchor"].masks
 
-    def _evict_stale_compact_caches(self, width_key: tuple) -> None:
-        """Widths only shrink as the density ladder descends — executables
-        compiled for an older (wider) signature can never be hit again and
-        would pin dead HLO + donated buffers for the rest of the run."""
-        for k in [k for k in self._compact_step_cache if k[1] != width_key]:
-            del self._compact_step_cache[k]
-        for k in [k for k in self._compact_eval_cache if k != width_key]:
-            del self._compact_eval_cache[k]
+    def _evict_stale_plan_caches(
+        self, width_key: tuple, nm_key: Optional[tuple] = None
+    ) -> None:
+        """The ladder only descends — executables compiled for an older
+        (wider, or differently-indexed) plan signature can never be hit
+        again and would pin dead HLO + donated buffers for the rest of the
+        run. ``nm_key=None`` (the compact-eval path) evicts on widths
+        only."""
+        for k in [
+            k
+            for k in self._plan_step_cache
+            if k[1] != width_key or (nm_key is not None and k[2] != nm_key)
+        ]:
+            del self._plan_step_cache[k]
+        for k in [k for k in self._plan_eval_cache if k != width_key]:
+            del self._plan_eval_cache[k]
 
     def _export_cache_gauges(self) -> None:
         self.compact_metrics.set_gauge(
-            "compact_train_cache_size", len(self._compact_step_cache)
+            "plan_step_cache_size", len(self._plan_step_cache)
         )
         self.compact_metrics.set_gauge(
-            "compact_eval_cache_size", len(self._compact_eval_cache)
-        )
-        self.compact_metrics.set_gauge(
-            "nm_exec_cache_size", len(self._nm_step_cache)
+            "plan_eval_cache_size", len(self._plan_eval_cache)
         )
 
     # --------------------------------------------------------------- level
@@ -807,11 +760,9 @@ class PruningHarness:
                         flush=True,
                     )
         # After any mid-level restore, so the anchor is the true level-start
-        # full state (post-rewind, post-resume) and a resumed level re-enters
-        # compaction from the restored full coordinates. N:M enters second
-        # so its plan sees the compact-sliced masks when compaction commits.
-        self._maybe_enter_compact_train()
-        self._maybe_enter_nm_exec()
+        # full state (post-rewind, post-resume) and a resumed level
+        # re-derives its ExecutionPlan from the restored full coordinates.
+        self._enter_plan()
         try:
             for epoch in range(start_epoch, epochs_per_level):
                 # Trace the second epoch of level 0 (first is
@@ -877,8 +828,7 @@ class PruningHarness:
                         level, epoch, self._full_state(), meta=meta
                     )
         finally:
-            self._exit_nm_exec()
-            self._exit_compact_train()
+            self._exit_plan()
 
         return self.metrics.finish_level(
             level,

@@ -11,16 +11,20 @@ is padded up to the smallest bucket >= n (split at the largest bucket), so
 at steady state no request ever triggers a fresh XLA trace. Compile-cache
 hits/misses are reported through ServeMetrics.
 
-A checkpoint can execute through three backends (``backend=``): ``masked``
-(fold ``w * m`` once, serve dense), ``compact`` (slice dead channels,
-physically smaller HLO), or ``nm`` (gather N:M-surviving rows through the
-sparse/nm_execute.py index plan — masks are folded first, so the gathered
-forward reads exact already-masked weights). ``auto`` picks per checkpoint:
-compact when channel sparsity actually shrinks the model, else nm when the
-plan routes any layer, else masked. With an ``aot_cache``
-(serve/fleet/aot_cache.py) each bucket's compiled executable is looked up
-on disk before invoking XLA — ``xla_compiles_total`` counts only REAL
-compiles, so a warm cache provably makes construction compile-free.
+Backend selection is delegated to the ONE planner (sparse/plan.py
+``plan_execution``): ``backend="auto"``/``"mixed"`` let it compose —
+channel-compact where dead channels actually shrink the checkpoint
+(serving commits on ANY real shrinkage: no optimizer state to slice), N:M
+gathering where the index plan routes a layer over the survivors, and
+masked-dense where neither pays — while ``masked``/``compact``/``nm`` pin
+a single backend (``compact`` raises loudly when the architecture has no
+compaction graph; ``nm`` degrades honestly to masked when nothing routes).
+Masks are folded before any slicing/gathering, so every backend reads
+exact already-masked weights; ``engine.plan.report`` carries the per-layer
+decision table. With an ``aot_cache`` (serve/fleet/aot_cache.py) each
+bucket's compiled executable is looked up on disk before invoking XLA —
+``xla_compiles_total`` counts only REAL compiles, so a warm cache provably
+makes construction compile-free.
 
 Serving is single-process/single-program by design — the training-side mesh
 machinery (sharded steps, multihost barriers) is deliberately not involved;
@@ -51,10 +55,21 @@ DEFAULT_BUCKETS = (1, 8, 32, 128)
 
 # Executable-surface hook: the plan-signature kind for the dense fallback
 # (no sparse plan). The sparse kinds live next to their plan dataclasses
-# (sparse/compact.py, sparse/nm_execute.py); analysis/exec_manifest.py
-# enumerates every PLAN_SIGNATURE_KIND declaration to bound the set of
-# plan formats an AOT cache key can carry.
+# (sparse/compact.py, sparse/nm_execute.py, sparse/plan.py for "mixed");
+# analysis/exec_manifest.py enumerates every PLAN_SIGNATURE_KIND
+# declaration to bound the set of plan formats an AOT cache key can carry.
 PLAN_SIGNATURE_KIND = "masked"
+
+# backend knob -> (compact mode, nm mode) handed to the planner. "mixed"
+# is the explicit spelling of what "auto" already does — both backends
+# offered, the planner composes whatever pays.
+_BACKEND_MODES = {
+    "masked": ("off", "off"),
+    "compact": ("force", "off"),
+    "nm": ("off", "auto"),
+    "auto": ("auto", "auto"),
+    "mixed": ("auto", "auto"),
+}
 
 
 def _clone_factory(model):
@@ -96,6 +111,8 @@ class InferenceEngine:
         model_factory=None,
         backend: Optional[str] = None,
         aot_cache=None,
+        nm_min_axis_savings: Optional[float] = None,
+        autotune: str = "off",
     ):
         self.model = model
         self.buckets = tuple(sorted({int(b) for b in buckets}))
@@ -111,89 +128,76 @@ class InferenceEngine:
         self.nm_plan_report: Optional[dict] = None
         if backend is None:
             backend = "compact" if compact else "masked"
-        if backend not in ("masked", "compact", "nm", "auto"):
+        if backend not in _BACKEND_MODES:
             raise ValueError(f"unknown serving backend {backend!r}")
         factory = model_factory or _clone_factory(model)
-        if backend == "auto":
-            backend = self._pick_backend(model, params, masks, batch_stats)
-        self.backend = backend
-        if backend == "compact":
-            # Dead-channel compaction (sparse/): slice all-zero fan-out
-            # channels out of the checkpoint and serve the physically
-            # smaller model — the AOT lower below then compiles the smaller
-            # HLO. Numerically equivalent to the masked-dense forward up to
-            # fp reassociation (tests/test_sparse.py pins the tolerance).
-            from ..sparse import build_graph, compact_params
+        from ..sparse import compact_stats, compact_tree, plan_execution
+        from ..sparse.nm_execute import MIN_AXIS_SAVINGS
 
-            graph = build_graph(model, params)
-            result = compact_params(params, masks, graph, batch_stats)
-            self.model = factory(width_overrides=result.width_overrides)
-            self.compaction = result.report
-            self._variables = {"params": result.params}
-            if result.batch_stats:
-                self._variables["batch_stats"] = result.batch_stats
-            if metrics:
-                metrics.record_compaction(result.report)
-            self._plan_signature = result.plan_signature()
-        elif backend == "nm":
-            # Gathered N:M execution (sparse/nm_execute.py): fold masks
-            # first — NM modules read raw kernel rows, so the folded params
-            # ARE the masked weights — then route eligible layers through
-            # static gather index maps. Unroutable checkpoints (no layer
-            # clears the savings bar) degrade honestly to masked.
-            from ..sparse.nm_execute import build_nm_plan
-
-            folded = masking.apply_masks(params, masks)
-            plan = build_nm_plan(model, masks)
-            if plan.overrides:
-                self.model = factory(nm_overrides=plan.overrides)
-                self.nm_plan_report = {
-                    "routed_layers": len(plan.overrides),
-                    "coverage_frac": plan.report["coverage_frac"],
-                    "eligible_params": plan.report["eligible_params"],
-                    "routed_params": plan.report["routed_params"],
-                }
-                if metrics:
-                    metrics.record_nm(self.nm_plan_report)
-                self._plan_signature = plan.plan_signature()
-            else:
-                self.backend = "masked"
-                self._plan_signature = (PLAN_SIGNATURE_KIND,)
-            self._variables = {"params": folded}
-            if batch_stats:
-                self._variables["batch_stats"] = batch_stats
+        compact_mode, nm_mode = _BACKEND_MODES[backend]
+        # The ONE planner (sparse/plan.py) produces the backend decision.
+        # compact_min_savings=0 is serving's commit rule: any real shrinkage
+        # pays at inference (no optimizer state to slice), which is exactly
+        # the params_after < params_before probe this replaced. The real
+        # batch_stats are handed to the planner — compaction slices attached
+        # BN stats, so an empty tree would fail the probe for BN models.
+        plan = plan_execution(
+            model,
+            params,
+            masks,
+            batch_stats or {},
+            model_factory=factory,
+            compact=compact_mode,
+            nm=nm_mode,
+            compact_min_savings=0.0,
+            nm_min_axis_savings=(
+                MIN_AXIS_SAVINGS
+                if nm_min_axis_savings is None
+                else nm_min_axis_savings
+            ),
+            autotune=autotune,
+        )
+        self.plan = plan
+        self.backend = plan.kind
+        self._plan_signature = plan.plan_signature()
+        # Fold once: pruned weights become literal zeros in the served
+        # params, so per-request forwards skip the mask multiply entirely —
+        # and any N:M gathers read exact already-masked weights.
+        folded = masking.apply_masks(params, masks)
+        if plan.compaction is not None:
+            # Slice the folded checkpoint to the committed widths and serve
+            # the physically smaller model — the AOT lower below compiles
+            # the smaller HLO. Numerically equivalent to the masked-dense
+            # forward up to fp reassociation (tests/test_sparse.py pins the
+            # tolerance).
+            self._variables = {
+                "params": compact_tree(folded, plan.compaction)
+            }
+            cstats = compact_stats(batch_stats or {}, plan.compaction)
+            if cstats:
+                self._variables["batch_stats"] = cstats
+            self.compaction = plan.compaction.report
         else:
-            # Fold once: pruned weights become literal zeros in the served
-            # params, so per-request forwards skip the mask multiply
-            # entirely.
-            folded = masking.apply_masks(params, masks)
             self._variables = {"params": folded}
             if batch_stats:
                 self._variables["batch_stats"] = batch_stats
-            self._plan_signature = (PLAN_SIGNATURE_KIND,)
+        if plan.width_overrides or plan.nm_overrides:
+            self.model = factory(
+                width_overrides=plan.width_overrides,
+                nm_overrides=plan.nm_overrides,
+            )
+        if plan.nm is not None:
+            self.nm_plan_report = {
+                "routed_layers": len(plan.nm.overrides),
+                "coverage_frac": plan.nm.report["coverage_frac"],
+                "eligible_params": plan.nm.report["eligible_params"],
+                "routed_params": plan.nm.report["routed_params"],
+            }
+        if metrics:
+            metrics.record_plan(plan.report)
         self.num_classes = None  # set by the first compile (output aval)
         self._compiled: dict[int, Any] = {}
         self._compile_lock = threading.Lock()
-
-    @staticmethod
-    def _pick_backend(model, params, masks, batch_stats) -> str:
-        """auto: compact when dead channels actually shrink the model, else
-        nm when the plan routes at least one layer, else masked. The real
-        batch_stats must be probed too — compaction slices attached BN
-        stats, so an empty tree would fail the probe for every BN model."""
-        from ..sparse import CompactionError, build_graph, compact_params
-        from ..sparse.nm_execute import build_nm_plan
-
-        try:
-            graph = build_graph(model, params)
-            result = compact_params(params, masks, graph, batch_stats or {})
-            if result.report["params_after"] < result.report["params_before"]:
-                return "compact"
-        except CompactionError:
-            pass  # architecture without a compaction graph — try nm
-        if build_nm_plan(model, masks).overrides:
-            return "nm"
-        return "masked"
 
     # ----------------------------------------------------------- compiling
     def _apply(self, variables, images):
@@ -318,6 +322,14 @@ class InferenceEngine:
                 "channels_after": self.compaction["channels_after"],
                 "compacted_spaces": self.compaction["compacted_spaces"],
             }
+        # The planner's machine-readable routing table: why each eligible
+        # layer (and the compaction stage) landed on its backend. JSON-safe
+        # scalars only, so /info can ship it verbatim.
+        out["plan"] = {
+            "kind": self.plan.kind,
+            "autotune": self.plan.report["autotune"],
+            "decisions": self.plan.decisions,
+        }
         return out
 
     # -------------------------------------------------------- construction
@@ -408,6 +420,11 @@ class InferenceEngine:
             compact=compact,
             backend=backend,
             aot_cache=aot_cache,
+            # The experiment's planner knobs travel to serving: one config
+            # surface for the routing thresholds (the compact commit rule
+            # stays serving's own threshold-0 "any shrinkage pays").
+            nm_min_axis_savings=cfg.planner.nm_min_axis_savings,
+            autotune=cfg.planner.autotune,
             # Re-instantiate through create_model so the compacted/gathered
             # model gets the exact same stem/dtype/attention wiring.
             model_factory=lambda width_overrides=None, nm_overrides=None: (

@@ -1,12 +1,13 @@
 """FleetEngine — every checkpoint of an experiment family behind one door.
 
 One process, N models: the registry names every saved level (masked-dense,
-compacted, or N:M-gathered — ``backend="auto"`` picks per checkpoint), and
-requests route on a ``model`` field. Each resident model owns a full
-serving stack — InferenceEngine (per-model AOT bucket cache), a
-DynamicBatcher (so one model's burst cannot head-of-line-block another's
-queue), and a labelled ServeMetrics from the shared MetricsHub (so two
-models' ``compaction_params_dense`` are distinct series, not an overwrite).
+compacted, N:M-gathered, or a mix — ``backend="auto"``/``"mixed"`` hand
+each checkpoint to the one planner, sparse/plan.py), and requests route on
+a ``model`` field. Each resident model owns a full serving stack —
+InferenceEngine (per-model AOT bucket cache), a DynamicBatcher (so one
+model's burst cannot head-of-line-block another's queue), and a labelled
+ServeMetrics from the shared MetricsHub (so two models'
+``plan_params_dense`` are distinct series, not an overwrite).
 
 Weight paging: at most ``max_resident_models`` models hold weights +
 executables at once, evicted LRU on page-in of the next. Page-in cost is

@@ -11,9 +11,9 @@ Multi-model (fleet) serving attaches a label set to each instance
 (``labels=(("model", "level_3"),)``) and renders every instance through one
 ``MetricsHub``: samples are grouped by metric NAME across instances so the
 exposition carries exactly one ``# TYPE`` line per metric with one labelled
-sample per model — two engines exporting ``compaction_params_dense`` are
-distinct series, not a silent overwrite (the PR 11 collision fix; regression
-test in tests/test_fleet.py).
+sample per model — two engines exporting ``plan_params_dense`` are distinct
+series, not a silent overwrite (the PR 11 collision fix; regression test in
+tests/test_fleet.py).
 
 Quantiles (p50/p99) are computed from a bounded sliding window of recent
 latencies rather than from the histogram buckets: the window gives exact
@@ -80,25 +80,26 @@ class ServeMetrics:
     def compile_miss(self) -> None:
         self.inc("compile_cache_misses_total")
 
-    def record_compaction(self, report: dict) -> None:
-        """Export the dead-channel compaction outcome (sparse/compact.py) as
-        gauges: dense vs compacted parameter and channel counts, so a
-        scraper (or the bench) can read the size the server ACTUALLY
-        compiled, not just the mask density."""
-        self.set_gauge("compaction_params_dense", report["params_before"])
-        self.set_gauge("compaction_params_compacted", report["params_after"])
-        self.set_gauge("compaction_channels_dense", report["channels_before"])
+    def record_plan(self, report: dict) -> None:
+        """Export an ExecutionPlan report (sparse/plan.py) as the unified
+        ``plan_*`` gauge family: per-layer backend decision counts, N:M
+        coverage, and — when compaction was planned — the dense vs compacted
+        parameter/channel counts, so a scraper (or the bench) can read the
+        size and routing the process ACTUALLY compiled, not just the mask
+        density. Replaces the parallel ``compaction_*``/``nm_*`` families."""
+        counts = report.get("backend_counts", {})
+        self.set_gauge("plan_layers_nm", counts.get("nm_layers", 0))
+        self.set_gauge("plan_layers_dense", counts.get("dense_layers", 0))
         self.set_gauge(
-            "compaction_channels_compacted", report["channels_after"]
+            "plan_spaces_compacted", counts.get("compact_spaces", 0)
         )
-        self.set_gauge("compaction_spaces_compacted", report["compacted_spaces"])
-
-    def record_nm(self, report: dict) -> None:
-        """Export the gathered N:M execution outcome (sparse/nm_execute.py):
-        how much of the matmul-heavy weight mass actually routes through the
-        gathered path, so "served as N:M" is an observable claim."""
-        self.set_gauge("nm_routed_layers", report.get("routed_layers", 0))
-        self.set_gauge("nm_coverage_frac", report.get("coverage_frac", 0.0))
+        self.set_gauge("plan_coverage_frac", report.get("coverage_frac", 0.0))
+        comp = report.get("compaction") or {}
+        if "params_before" in comp:
+            self.set_gauge("plan_params_dense", comp["params_before"])
+            self.set_gauge("plan_params_compacted", comp["params_after"])
+            self.set_gauge("plan_channels_dense", comp["channels_before"])
+            self.set_gauge("plan_channels_compacted", comp["channels_after"])
 
     def observe_latency_ms(self, ms: float) -> None:
         with self._lock:

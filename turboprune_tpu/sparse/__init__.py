@@ -22,10 +22,16 @@ nm_execute.py     gathered N:M execution — static int32 index maps +
                   custom-VJP reduced-width matmul, NM* drop-in modules and
                   ``build_nm_plan``; the second execution backend next to
                   compaction (composable: compact first, N:M the survivors)
+plan.py           ``plan_execution`` — the ONE planner that turns live masks
+                  into an ``ExecutionPlan`` (compact the dead channels, N:M
+                  the scattered survivors, dense where neither pays, with an
+                  optional cost-model/micro-bench autotune pass) consumed by
+                  the harness, the serving engine, and the bench alike
 
-Consumed by serve/engine.py (``compact: true`` load path), the harness's
-compact eval AND compact train paths, and bench.py's ``compaction`` /
-``compact_train`` / ``nm_frontier`` stages.
+Consumed by serve/engine.py (planner-driven backend selection), the
+harness's compact eval and plan-execution paths, and bench.py's
+``compaction`` / ``compact_train`` / ``nm_frontier`` / ``mixed_plan``
+stages.
 """
 
 from .compact import (
@@ -48,6 +54,13 @@ from .nm import (
     project_masks,
 )
 from .nm_execute import NMExecPlan, build_nm_plan
+from .plan import (
+    AUTOTUNE_MODES,
+    COMPACT_MODES,
+    NM_MODES,
+    ExecutionPlan,
+    plan_execution,
+)
 from .train_compact import (
     compact_train_state,
     expand_opt_state,
@@ -57,11 +70,15 @@ from .train_compact import (
 )
 
 __all__ = [
+    "AUTOTUNE_MODES",
+    "COMPACT_MODES",
     "CompactionError",
     "CompactionPlan",
     "CompactionResult",
+    "ExecutionPlan",
     "NMError",
     "NMExecPlan",
+    "NM_MODES",
     "PropagationGraph",
     "analyze_masks",
     "build_graph",
@@ -78,6 +95,7 @@ __all__ = [
     "expand_tree",
     "nm_pattern_inaxis",
     "nm_pattern_transposable",
+    "plan_execution",
     "project_masks",
     "slice_opt_state",
     "width_signature",
